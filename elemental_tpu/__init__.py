@@ -1,0 +1,12 @@
+"""elemental_tpu: TPU-native distributed dense linear algebra.
+
+A from-scratch JAX/XLA/shard_map re-design of the capabilities of the
+reference framework (Elemental: distributed-memory dense linear algebra over
+a 2-D process grid).  See SURVEY.md for the blueprint.
+"""
+from .core.dist import Dist, MC, MD, MR, VC, VR, STAR, CIRC, LEGAL_PAIRS
+from .core.grid import Grid, default_grid, set_default_grid
+from .core.distmatrix import DistMatrix, from_global, to_global, zeros
+from .redist.engine import redistribute, transpose_dist
+
+__version__ = "0.1.0"

@@ -1,0 +1,185 @@
+"""DistMatrix: a distributed matrix as a JAX pytree.
+
+The TPU-native re-design of the reference's
+``DistMatrix<T,ColDist,RowDist>`` (Elemental
+``include/El/core/DistMatrix/``): one dataclass whose single array leaf is
+
+  * INSIDE ``shard_map``: this device's local cyclic block, shape
+    ``(local_rows, local_cols)`` -- exactly Elemental's local ``Matrix<T>``
+    (local(iLoc,jLoc) = global(iLoc*colStride + colShift, ...)), padded to the
+    uniform per-device extent ``ceil(extent/stride)`` with ZEROS (SPMD needs
+    static uniform shapes; keeping padding zero makes matmul-family ops
+    padding-oblivious).
+
+  * OUTSIDE ``shard_map``: the "stacked storage" array of shape
+    ``(S_col*local_rows, S_row*local_cols)`` sharded with
+    ``PartitionSpec(spec_component(cdist), spec_component(rdist))`` -- each
+    device's tile of the storage array IS its local block.  The storage array
+    is an index-permutation of the mathematical matrix, never interpreted
+    directly; use ``to_global``/``from_global`` at the API edge.
+
+All metadata (global shape, distribution tags, alignments, grid) is static
+pytree aux data, so jit re-specializes per distribution -- the moral analog
+of the reference's one-template-specialization-per-pair design.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from . import indexing as ix
+from .dist import Dist, STAR, LEGAL_PAIRS, stride as dist_stride, spec_component, rank_of
+from .grid import Grid, default_grid
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["local"],
+    meta_fields=["gshape", "cdist", "rdist", "calign", "ralign", "grid"],
+)
+@dataclasses.dataclass(frozen=True)
+class DistMatrix:
+    local: Any                    # jax.Array leaf (local block / stacked storage)
+    gshape: tuple                 # true (unpadded) global shape (m, n)
+    cdist: Dist
+    rdist: Dist
+    calign: int
+    ralign: int
+    grid: Grid
+
+    # ---- static layout math -----------------------------------------
+    @property
+    def col_stride(self) -> int:
+        return dist_stride(self.cdist, self.grid.height, self.grid.width)
+
+    @property
+    def row_stride(self) -> int:
+        return dist_stride(self.rdist, self.grid.height, self.grid.width)
+
+    @property
+    def local_rows(self) -> int:
+        return ix.max_local_length(self.gshape[0], self.col_stride)
+
+    @property
+    def local_cols(self) -> int:
+        return ix.max_local_length(self.gshape[1], self.row_stride)
+
+    @property
+    def local_shape(self) -> tuple:
+        return (self.local_rows, self.local_cols)
+
+    @property
+    def spec(self) -> P:
+        return P(spec_component(self.cdist), spec_component(self.rdist))
+
+    @property
+    def dist(self) -> tuple:
+        return (self.cdist, self.rdist)
+
+    @property
+    def dtype(self):
+        return self.local.dtype
+
+    def col_shift(self):
+        """Traced: first global row owned by this device (shard_map only)."""
+        g = self.grid
+        return ix.shift(rank_of(self.cdist, g.height, g.width), self.calign, self.col_stride)
+
+    def row_shift(self):
+        g = self.grid
+        return ix.shift(rank_of(self.rdist, g.height, g.width), self.ralign, self.row_stride)
+
+    # ---- functional update helpers ----------------------------------
+    def with_local(self, local) -> "DistMatrix":
+        return dataclasses.replace(self, local=local)
+
+    def like(self, local, gshape=None) -> "DistMatrix":
+        return dataclasses.replace(
+            self, local=local, gshape=self.gshape if gshape is None else gshape
+        )
+
+    def astype(self, dtype) -> "DistMatrix":
+        return self.with_local(self.local.astype(dtype))
+
+    def __repr__(self):
+        return (
+            f"DistMatrix[{self.cdist.value},{self.rdist.value}]"
+            f"(gshape={self.gshape}, grid={self.grid}, dtype={self.local.dtype})"
+        )
+
+
+def _check_pair(cdist: Dist, rdist: Dist):
+    if (cdist, rdist) not in LEGAL_PAIRS:
+        raise ValueError(f"illegal distribution pair [{cdist},{rdist}]")
+
+
+# ---------------------------------------------------------------------
+# Global <-> storage bridges (the API edge; cf. SURVEY.md §8.1 item 2)
+# ---------------------------------------------------------------------
+
+def _storage_index(extent: int, stride: int, align: int):
+    """Flat index map: storage position (q*l + iLoc) <- global index.
+
+    Returns int array of length stride*l whose entries are global indices
+    (>= extent for padding positions).
+    """
+    l = ix.max_local_length(extent, stride)
+    q = jnp.arange(stride).reshape(stride, 1)
+    il = jnp.arange(l).reshape(1, l)
+    gi = il * stride + (q - align) % stride
+    # mark padding (gi >= extent handled by take-fill)
+    return gi.reshape(-1)
+
+
+def from_global(arr, cdist: Dist, rdist: Dist, grid: Grid | None = None,
+                calign: int = 0, ralign: int = 0, device_put: bool = True) -> DistMatrix:
+    """Build a DistMatrix (stacked-storage form) from a replicated global array."""
+    _check_pair(cdist, rdist)
+    grid = grid or default_grid()
+    arr = jnp.asarray(arr)
+    m, n = arr.shape
+    r, c = grid.height, grid.width
+    sc = dist_stride(cdist, r, c)
+    sr = dist_stride(rdist, r, c)
+    ridx = _storage_index(m, sc, calign)
+    cidx = _storage_index(n, sr, ralign)
+    stor = jnp.take(arr, ridx, axis=0, mode="fill", fill_value=0)
+    stor = jnp.take(stor, cidx, axis=1, mode="fill", fill_value=0)
+    dm = DistMatrix(stor, (m, n), cdist, rdist, calign, ralign, grid)
+    if device_put:
+        dm = dm.with_local(jax.device_put(stor, grid.sharding(dm.spec)))
+    return dm
+
+
+def to_global(A: DistMatrix):
+    """Recover the mathematical (m, n) array from stacked storage."""
+    m, n = A.gshape
+    sc, sr = A.col_stride, A.row_stride
+    lr, lc = A.local_rows, A.local_cols
+    stor = A.local
+    # inverse permutation: global i lives at storage row owner(i)*lr + i//sc
+    i = jnp.arange(m)
+    ri = ((i + A.calign) % sc) * lr + i // sc
+    j = jnp.arange(n)
+    cj = ((j + A.ralign) % sr) * lc + j // sr
+    out = jnp.take(stor, ri, axis=0)
+    out = jnp.take(out, cj, axis=1)
+    return out
+
+
+def zeros(m: int, n: int, cdist: Dist = Dist.MC, rdist: Dist = Dist.MR,
+          grid: Grid | None = None, dtype=jnp.float32,
+          calign: int = 0, ralign: int = 0) -> DistMatrix:
+    _check_pair(cdist, rdist)
+    grid = grid or default_grid()
+    r, c = grid.height, grid.width
+    sc, sr = dist_stride(cdist, r, c), dist_stride(rdist, r, c)
+    lr, lc = ix.max_local_length(m, sc), ix.max_local_length(n, sr)
+    dm = DistMatrix(None, (m, n), cdist, rdist, calign, ralign, grid)
+    stor = jnp.zeros((sc * lr, sr * lc), dtype)
+    return dm.with_local(jax.device_put(stor, grid.sharding(dm.spec)))

@@ -1,0 +1,95 @@
+"""Process grid over a TPU device mesh.
+
+The reference's ``El::Grid`` (``src/core/Grid.cpp``) splits an MPI
+communicator into an r x c logical grid and derives the MC / MR / VC / VR /
+MD sub-communicators.  Here the grid IS a ``jax.sharding.Mesh`` with named
+axes ``('mc', 'mr')``; the "sub-communicators" are simply the axis names
+handed to collectives inside ``shard_map``:
+
+  MC comm (size r)  -> axis 'mc'
+  MR comm (size c)  -> axis 'mr'
+  VC comm (size p)  -> axes ('mr','mc')  (column-major rank = mc + r*mr)
+  VR comm (size p)  -> axes ('mc','mr')  (row-major rank    = mr + c*mc)
+
+Grid is hashable/immutable so it can ride in DistMatrix pytree metadata
+(static under jit).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def _near_square_height(p: int) -> int:
+    r = int(math.isqrt(p))
+    while p % r != 0:
+        r -= 1
+    return r
+
+
+class Grid:
+    """An r x c logical device grid backed by a named-axis Mesh."""
+
+    def __init__(self, devices=None, height: int | None = None):
+        if devices is None:
+            devices = jax.devices()
+        devices = list(devices)
+        p = len(devices)
+        r = _near_square_height(p) if height is None else height
+        if p % r != 0:
+            raise ValueError(f"grid height {r} does not divide device count {p}")
+        c = p // r
+        self._r, self._c = r, c
+        self._devices = tuple(devices)
+        self.mesh = Mesh(np.asarray(devices).reshape(r, c), ("mc", "mr"))
+
+    @property
+    def height(self) -> int:  # r == |MC|
+        return self._r
+
+    @property
+    def width(self) -> int:   # c == |MR|
+        return self._c
+
+    @property
+    def size(self) -> int:    # p
+        return self._r * self._c
+
+    @property
+    def lcm(self) -> int:     # MD stride in the reference
+        return self._r * self._c // math.gcd(self._r, self._c)
+
+    def sharding(self, spec: PartitionSpec) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
+
+    # --- hashable static metadata -------------------------------------
+    def _key(self):
+        return (self._r, self._c, tuple(id(d) for d in self._devices))
+
+    def __eq__(self, other):
+        return isinstance(other, Grid) and self._key() == other._key()
+
+    def __hash__(self):
+        return hash((self._r, self._c, len(self._devices)))
+
+    def __repr__(self):
+        return f"Grid({self._r}x{self._c})"
+
+
+_default_grid: Grid | None = None
+
+
+def default_grid() -> Grid:
+    """Lazily-built grid over all visible devices (``Grid::Default()``)."""
+    global _default_grid
+    if _default_grid is None:
+        _default_grid = Grid()
+    return _default_grid
+
+
+def set_default_grid(g: Grid) -> None:
+    global _default_grid
+    _default_grid = g

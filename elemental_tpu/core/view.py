@@ -1,0 +1,84 @@
+"""Views: contiguous global sub-matrices of a DistMatrix.
+
+The analog of the reference's FLAME partitioning + ``View``/``LockedView``
+(Elemental ``include/El/core/FlamePart/``, ``View.hpp``): blocked algorithms
+walk a matrix by repeatedly taking contiguous index-range views.
+
+With the element-cyclic layout, a global range [s, e) whose start is a
+multiple of the distribution stride maps to the contiguous LOCAL range
+[s/S, ceil(e/S)) on every device -- so a view is a pure-local (zero-comm)
+slice of the stacked storage array, done with static offsets (jit-friendly).
+
+Constraint (the "grain" rule, SURVEY.md §8.1 item 3): slice starts must be
+multiples of the dim's stride; ends must be multiples or the true extent.
+Blocked algorithms pick block sizes as multiples of lcm(r, c) (or r*c when
+V-distributions are involved) so this always holds.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from . import indexing as ix
+from .distmatrix import DistMatrix
+
+
+def _local_range(s: int, e: int, extent: int, S: int, align: int):
+    if align != 0:
+        raise ValueError("views require zero alignment")
+    if s % S != 0:
+        raise ValueError(f"view start {s} not a multiple of stride {S}")
+    if e < s or e > extent:
+        raise ValueError(f"view range [{s},{e}) out of bounds for extent {extent}")
+    if e != extent and e % S != 0:
+        raise ValueError(f"view end {e} not a multiple of stride {S} nor the extent")
+    sl = s // S
+    el = ix.max_local_length(e, S)
+    return sl, el
+
+
+def _blocked(stor, Sc, Sr):
+    lr = stor.shape[0] // Sc
+    lc = stor.shape[1] // Sr
+    return stor.reshape(Sc, lr, Sr, lc), lr, lc
+
+
+def view(A: DistMatrix, rows=None, cols=None) -> DistMatrix:
+    """A[rows[0]:rows[1], cols[0]:cols[1]] as a DistMatrix (same dists)."""
+    m, n = A.gshape
+    rows = (0, m) if rows is None else rows
+    cols = (0, n) if cols is None else cols
+    Sc, Sr = A.col_stride, A.row_stride
+    rsl, rel = _local_range(rows[0], rows[1], m, Sc, A.calign)
+    csl, cel = _local_range(cols[0], cols[1], n, Sr, A.ralign)
+    b, lr, lc = _blocked(A.local, Sc, Sr)
+    sub = b[:, rsl:rel, :, csl:cel].reshape(Sc * (rel - rsl), Sr * (cel - csl))
+    gshape = (min(rows[1], m) - rows[0], min(cols[1], n) - cols[0])
+    return dataclasses.replace(A, local=sub, gshape=gshape)
+
+
+def update_view(A: DistMatrix, B: DistMatrix, rows=None, cols=None) -> DistMatrix:
+    """Functionally write sub-matrix B into A at the given global ranges."""
+    m, n = A.gshape
+    rows = (0, m) if rows is None else rows
+    cols = (0, n) if cols is None else cols
+    Sc, Sr = A.col_stride, A.row_stride
+    rsl, rel = _local_range(rows[0], rows[1], m, Sc, A.calign)
+    csl, cel = _local_range(cols[0], cols[1], n, Sr, A.ralign)
+    b, lr, lc = _blocked(A.local, Sc, Sr)
+    bB = B.local.reshape(Sc, rel - rsl, Sr, cel - csl)
+    out = b.at[:, rsl:rel, :, csl:cel].set(bB)
+    return A.with_local(out.reshape(A.local.shape))
+
+
+def round_up(x: int, grain: int) -> int:
+    return -(-x // grain) * grain
+
+
+def split_point(n: int, grain: int) -> int:
+    """A near-halving split that respects the grain rule."""
+    half = round_up(n // 2, grain)
+    if half == 0 or half >= n:
+        half = grain
+    return min(half, n)

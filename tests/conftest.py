@@ -1,0 +1,41 @@
+"""Test harness: a virtual 8-device CPU mesh.
+
+The analog of the reference's ``mpirun -np 8`` single-host oversubscription
+(SURVEY.md §5): the grid logic is identical at any scale, so host-only runs
+exercise every code path.  Must set env BEFORE importing jax.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "true")
+
+import jax  # noqa: E402
+
+# jax is pre-imported at interpreter startup in this image (axon plugin .pth),
+# so env vars alone are too late; config.update works pre-backend-init.
+jax.config.update("jax_platform_name", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", True)
+import pytest  # noqa: E402
+
+from elemental_tpu import Grid  # noqa: E402
+
+
+@pytest.fixture(scope="session", params=[(2, 4), (4, 2), (1, 8), (8, 1)],
+                ids=lambda rc: f"grid{rc[0]}x{rc[1]}")
+def any_grid(request):
+    r, c = request.param
+    return Grid(jax.devices()[: r * c], height=r)
+
+
+@pytest.fixture(scope="session")
+def grid24():
+    return Grid(jax.devices(), height=2)
+
+
+@pytest.fixture(scope="session")
+def grid42():
+    return Grid(jax.devices(), height=4)

@@ -1,0 +1,46 @@
+"""from_global/to_global storage-permutation roundtrips for every pair."""
+import numpy as np
+import pytest
+
+from elemental_tpu import LEGAL_PAIRS, DistMatrix, from_global, to_global
+
+
+def checkerboard(m, n):
+    i = np.arange(m)[:, None]
+    j = np.arange(n)[None, :]
+    return (i * 1000 + j).astype(np.float64)
+
+
+@pytest.mark.parametrize("pair", LEGAL_PAIRS, ids=lambda p: f"{p[0].value}_{p[1].value}")
+def test_roundtrip(any_grid, pair):
+    F = checkerboard(13, 9)
+    A = from_global(F, *pair, grid=any_grid)
+    np.testing.assert_array_equal(np.asarray(to_global(A)), F)
+
+
+@pytest.mark.parametrize("calign,ralign", [(1, 0), (0, 1), (1, 3)])
+def test_roundtrip_aligned(grid24, calign, ralign):
+    from elemental_tpu import MC, MR
+
+    F = checkerboard(10, 11)
+    A = from_global(F, MC, MR, grid=grid24,
+                    calign=calign % 2, ralign=ralign % 4)
+    np.testing.assert_array_equal(np.asarray(to_global(A)), F)
+
+
+def test_local_blocks_are_cyclic_slices(grid24):
+    """Each device's storage tile equals the Elemental local matrix."""
+    from elemental_tpu import MC, MR
+
+    F = checkerboard(13, 9)
+    A = from_global(F, MC, MR, grid=grid24)
+    r, c = 2, 4
+    lr, lc = A.local_rows, A.local_cols
+    stor = np.asarray(A.local)
+    for pr in range(r):
+        for pc in range(c):
+            tile = stor[pr * lr:(pr + 1) * lr, pc * lc:(pc + 1) * lc]
+            want = np.zeros_like(tile)
+            loc = F[pr::r, pc::c]
+            want[: loc.shape[0], : loc.shape[1]] = loc
+            np.testing.assert_array_equal(tile, want)

@@ -1,0 +1,33 @@
+"""Property tests for the cyclic index math (Shift/Length semantics)."""
+import numpy as np
+
+from elemental_tpu.core import indexing as ix
+
+
+def test_partition_is_exact():
+    # every global index owned by exactly one rank, local indices contiguous
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        n = int(rng.integers(0, 40))
+        stride = int(rng.integers(1, 9))
+        align = int(rng.integers(0, stride))
+        seen = {}
+        for q in range(stride):
+            s = ix.shift(q, align, stride)
+            l = ix.length(n, s, stride)
+            assert l <= ix.max_local_length(n, stride)
+            for il in range(l):
+                i = il * stride + s
+                assert i < n
+                assert ix.owner(i, align, stride) == q
+                assert i not in seen
+                seen[i] = (q, il)
+        assert len(seen) == n
+
+
+def test_max_local_length_bounds():
+    for n in range(0, 30):
+        for stride in range(1, 9):
+            ml = ix.max_local_length(n, stride)
+            assert ml * stride >= n
+            assert (ml - 1) * stride < n or n == 0
