@@ -171,20 +171,54 @@ def to_dist(A: DistMatrix, cdist: Dist, rdist: Dist,
             out = _coldim_change(A, cdist)
             if out is not None:
                 return out
-        # [MC,MR] -> [VC,STAR]: via [MC,STAR] (gather) then partial filter
-        if src == (MC, MR) and dst == (VC, STAR):
-            return to_dist(to_dist(A, MC, STAR), VC, STAR)
-        if src == (MR, MC) and dst == (VR, STAR):
-            return to_dist(to_dist(A, MR, STAR), VR, STAR)
-        # [VC,STAR] -> [MC,MR] and friends: partial gather then filter
-        if src == (VC, STAR) and dst == (MC, MR):
-            return to_dist(to_dist(A, MC, STAR), MC, MR)
-        if src == (VR, STAR) and dst == (MR, MC):
-            return to_dist(to_dist(A, MR, STAR), MR, MC)
+        # composite chains of fast single-dim hops
+        chain = _CHAINS.get((src, dst))
+        if chain is not None:
+            out = A
+            for hop in chain:
+                out = to_dist(out, *hop)
+            return out
 
     # ---- generic fallback: through [STAR,STAR] ------------------------
     ss = to_star_star(A)
     return _from_star_star(ss.local, A.gshape, cdist, rdist, calign, ralign, g)
+
+
+#: Multi-hop routes (each hop is a fast single-dim change) for the pairs the
+#: blocked algorithms actually use.  The reference implements these as fused
+#: kernels (e.g. ``copy::Exchange`` for the [MC,MR]<->[MR,MC] transpose pair,
+#: ``src/blas_like/level1/Copy/Exchange.hpp``).  NOTE: chains whose first hop
+#: is a gather pay more ICI volume than a fused all_to_all would (~mn/r per
+#: device vs mn/p for the exchange pair) -- replacing the gather+filter hops
+#: with ``lax.all_to_all`` promote/demote kernels is a known optimization.
+_CHAINS = {
+    # transpose-pair exchange
+    ((MC, MR), (MR, MC)): ((MC, STAR), (VC, STAR), (VR, STAR), (MR, STAR), (MR, MC)),
+    ((MR, MC), (MC, MR)): ((MR, STAR), (VR, STAR), (VC, STAR), (MC, STAR), (MC, MR)),
+    # [MC,MR] -> 1-D cyclic forms and back
+    ((MC, MR), (VC, STAR)): ((MC, STAR), (VC, STAR)),
+    ((MC, MR), (VR, STAR)): ((MC, STAR), (VC, STAR), (VR, STAR)),
+    ((MC, MR), (STAR, VR)): ((STAR, MR), (STAR, VR)),
+    ((MC, MR), (STAR, VC)): ((STAR, MR), (STAR, VR), (STAR, VC)),
+    ((VC, STAR), (MC, MR)): ((MC, STAR), (MC, MR)),
+    ((VR, STAR), (MC, MR)): ((VC, STAR), (MC, STAR), (MC, MR)),
+    ((STAR, VR), (MC, MR)): ((STAR, MR), (MC, MR)),
+    ((STAR, VC), (MC, MR)): ((STAR, VR), (STAR, MR), (MC, MR)),
+    # [MR,MC] -> 1-D cyclic forms and back
+    ((MR, MC), (VR, STAR)): ((MR, STAR), (VR, STAR)),
+    ((MR, MC), (STAR, VC)): ((STAR, MC), (STAR, VC)),
+    ((VR, STAR), (MR, MC)): ((MR, STAR), (MR, MC)),
+    ((STAR, VC), (MR, MC)): ((STAR, MC), (MR, MC)),
+    # cross-dim single-replicated targets (SUMMA panel moves)
+    ((MC, MR), (MR, STAR)): ((MC, STAR), (VC, STAR), (VR, STAR), (MR, STAR)),
+    ((MC, MR), (STAR, MC)): ((STAR, MR), (STAR, VR), (STAR, VC), (STAR, MC)),
+    ((MR, MC), (MC, STAR)): ((MR, STAR), (VR, STAR), (VC, STAR), (MC, STAR)),
+    ((MR, MC), (STAR, MR)): ((STAR, MC), (STAR, VC), (STAR, VR), (STAR, MR)),
+    ((MR, STAR), (MC, MR)): ((VR, STAR), (VC, STAR), (MC, STAR), (MC, MR)),
+    ((STAR, MC), (MC, MR)): ((STAR, VC), (STAR, VR), (STAR, MR), (MC, MR)),
+    ((MC, STAR), (MR, MC)): ((VC, STAR), (VR, STAR), (MR, STAR), (MR, MC)),
+    ((STAR, MR), (MR, MC)): ((STAR, VR), (STAR, VC), (STAR, MC), (MR, MC)),
+}
 
 
 def _rowdim_change(A: DistMatrix, rdist: Dist) -> DistMatrix | None:
@@ -261,7 +295,34 @@ def _partial_ladder(A: DistMatrix, dim: int, src: Dist, dst: Dist) -> DistMatrix
         l_out = ix.max_local_length(extent, p)
         loc = _partial_filter_dim(A.local, dim, nblocks, sub, l_out)
         return _retag(A, dim, dst, loc)
+    if {src, dst} == {VC, VR}:
+        loc = _vc_vr_permute(A.local, src, r, c)
+        return _retag(A, dim, dst, loc)
     return None
+
+
+def _vc_vr_permute(x, src: Dist, r: int, c: int):
+    """[VC,*] <-> [VR,*]: a pure block permutation between the two 1-D rank
+    orderings (the reference does this with a single pairwise SendRecv --
+    ``copy::Exchange`` inside ``src/blas_like/level1/Copy/``); here one
+    ``lax.ppermute`` over the flattened ('mc','mr') axis (linear index
+    mc*c + mr, first name major).
+
+    VC rank v lives on device (mc=v%r, mr=v//r); VR rank v on
+    (mc=v//c, mr=v%c).  The residue class {i : i%p == v} moves wholesale
+    from its VC owner to its VR owner (or back).
+    """
+    p = r * c
+    if p == 1 or r == 1 or c == 1:
+        return x
+    # linear device index under ('mc','mr') = mc*c + mr; note VR rank v lives
+    # on (mc=v//c, mr=v%c), i.e. the linear device index IS the VR rank.
+    vc_dev = [(v % r) * c + v // r for v in range(p)]   # device holding VC rank v
+    if src is VC:
+        perm = [(vc_dev[v], v) for v in range(p)]
+    else:
+        perm = [(v, vc_dev[v]) for v in range(p)]
+    return lax.ppermute(x, ("mc", "mr"), perm)
 
 
 def _retag(A: DistMatrix, dim: int, d: Dist, loc) -> DistMatrix:
