@@ -1,0 +1,3 @@
+"""BLAS-like layer (reference: Elemental ``src/blas_like/``)."""
+from . import level1
+from .level3 import gemm, herk, syrk, trrk, trsm

@@ -218,6 +218,11 @@ _CHAINS = {
     ((STAR, MC), (MC, MR)): ((STAR, VC), (STAR, VR), (STAR, MR), (MC, MR)),
     ((MC, STAR), (MR, MC)): ((VC, STAR), (VR, STAR), (MR, STAR), (MR, MC)),
     ((STAR, MR), (MR, MC)): ((STAR, VR), (STAR, VC), (STAR, MC), (MR, MC)),
+    # V-form to the opposite M-form (Cholesky/Herk panel adjoint chains)
+    ((VC, STAR), (MR, STAR)): ((VR, STAR), (MR, STAR)),
+    ((VR, STAR), (MC, STAR)): ((VC, STAR), (MC, STAR)),
+    ((STAR, VC), (STAR, MR)): ((STAR, VR), (STAR, MR)),
+    ((STAR, VR), (STAR, MC)): ((STAR, VC), (STAR, MC)),
 }
 
 

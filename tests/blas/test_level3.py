@@ -1,0 +1,141 @@
+"""Level-3 correctness vs NumPy oracles.
+
+Mirrors the reference's ``tests/blas_like/Gemm.cpp`` strategy: run every
+SUMMA variant against the sequential product on a gathered copy
+(``--correctness`` residual), plus Trsm/Herk drivers (SURVEY.md §5).
+"""
+import numpy as np
+import pytest
+
+from elemental_tpu import MC, MR, STAR, from_global, to_global
+from elemental_tpu.blas import level3 as l3
+
+
+def _rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+def _dist(g, arr):
+    return from_global(arr, MC, MR, grid=g)
+
+
+@pytest.mark.parametrize("alg", ["C", "A", "B", "gspmd", "auto"])
+def test_gemm_algs(grid24, alg):
+    rng = _rng(1)
+    m, k, n = 24, 17, 20
+    A = rng.normal(size=(m, k))
+    B = rng.normal(size=(k, n))
+    C = l3.gemm(_dist(grid24, A), _dist(grid24, B), alg=alg, nb=8)
+    np.testing.assert_allclose(np.asarray(to_global(C)), A @ B, rtol=1e-12)
+
+
+@pytest.mark.parametrize("oa,ob", [("N", "T"), ("T", "N"), ("C", "C"), ("T", "T")])
+def test_gemm_orientations(grid42, oa, ob):
+    rng = _rng(2)
+    m, k, n = 12, 10, 14
+    A = rng.normal(size=(k, m) if oa != "N" else (m, k)) \
+        + 1j * rng.normal(size=(k, m) if oa != "N" else (m, k))
+    B = rng.normal(size=(n, k) if ob != "N" else (k, n)) \
+        + 1j * rng.normal(size=(n, k) if ob != "N" else (k, n))
+    op = {"N": lambda X: X, "T": lambda X: X.T, "C": lambda X: X.conj().T}
+    C = l3.gemm(_dist(grid42, A), _dist(grid42, B), orient_a=oa, orient_b=ob, nb=8)
+    np.testing.assert_allclose(np.asarray(to_global(C)), op[oa](A) @ op[ob](B), rtol=1e-12)
+
+
+def test_gemm_alpha_beta(grid24):
+    rng = _rng(3)
+    m, k, n = 16, 9, 11
+    A, B, C0 = rng.normal(size=(m, k)), rng.normal(size=(k, n)), rng.normal(size=(m, n))
+    out = l3.gemm(_dist(grid24, A), _dist(grid24, B), alpha=2.0, beta=-0.5,
+                  C=_dist(grid24, C0), alg="C", nb=8)
+    np.testing.assert_allclose(np.asarray(to_global(out)), 2.0 * A @ B - 0.5 * C0,
+                               rtol=1e-12)
+
+
+def test_gemm_any_grid(any_grid):
+    rng = _rng(4)
+    m, k, n = 13, 21, 8
+    A, B = rng.normal(size=(m, k)), rng.normal(size=(k, n))
+    C = l3.gemm(_dist(any_grid, A), _dist(any_grid, B), nb=16)
+    np.testing.assert_allclose(np.asarray(to_global(C)), A @ B, rtol=1e-12)
+
+
+@pytest.mark.parametrize("side", ["L", "R"])
+@pytest.mark.parametrize("uplo", ["L", "U"])
+@pytest.mark.parametrize("orient", ["N", "T", "C"])
+def test_trsm(grid24, side, uplo, orient):
+    rng = _rng(5)
+    m, n = 20, 12
+    d = m if side == "L" else n
+    T = rng.normal(size=(d, d)) + 1j * rng.normal(size=(d, d))
+    T = np.tril(T) if uplo == "L" else np.triu(T)
+    T += (2 * d) * np.eye(d)                      # well-conditioned
+    B = rng.normal(size=(m, n)) + 1j * rng.normal(size=(m, n))
+    op = {"N": T, "T": T.T, "C": T.conj().T}[orient]
+    X = l3.trsm(side, uplo, orient, _dist(grid24, T), _dist(grid24, B),
+                alpha=1.5, nb=8)
+    want = 1.5 * (np.linalg.solve(op, B) if side == "L"
+                  else np.linalg.solve(op.T, B.T).T)
+    np.testing.assert_allclose(np.asarray(to_global(X)), want, rtol=1e-11)
+
+
+def test_trsm_unit_diagonal(grid42):
+    rng = _rng(6)
+    m, n = 16, 7
+    B = rng.normal(size=(m, n))
+    # unit-diag: solver must ignore the stored diagonal
+    Tstored = np.tril(rng.normal(size=(m, m)))
+    np.fill_diagonal(Tstored, rng.normal(size=m) + 5)
+    Tunit = np.tril(Tstored, -1) + np.eye(m)
+    Xu = l3.trsm("L", "L", "N", _dist(grid42, Tstored), _dist(grid42, B),
+                 unit=True, nb=8)
+    np.testing.assert_allclose(np.asarray(to_global(Xu)),
+                               np.linalg.solve(Tunit, B), rtol=1e-11)
+
+
+@pytest.mark.parametrize("uplo", ["L", "U"])
+@pytest.mark.parametrize("orient", ["N", "C"])
+def test_herk(grid24, uplo, orient):
+    rng = _rng(7)
+    m, k = 18, 10
+    A = rng.normal(size=(m, k)) + 1j * rng.normal(size=(m, k))
+    if orient == "C":
+        A = A.conj().T.copy()      # op(A) A is m x k either way
+        Aop = A.conj().T
+    else:
+        Aop = A
+    C0 = rng.normal(size=(m, m)) + 1j * rng.normal(size=(m, m))
+    out = l3.herk(uplo, _dist(grid24, A), alpha=2.0, beta=0.5,
+                  C=_dist(grid24, C0), orient=orient, nb=8)
+    got = np.asarray(to_global(out))
+    want_tri = 2.0 * Aop @ Aop.conj().T + 0.5 * C0
+    tri = np.tril if uplo == "L" else np.triu
+    anti = np.triu if uplo == "L" else np.tril
+    np.testing.assert_allclose(tri(got), tri(want_tri), rtol=1e-12)
+    # other (strict) triangle untouched
+    np.testing.assert_allclose(anti(got, 1 if uplo == "L" else -1),
+                               anti(C0, 1 if uplo == "L" else -1), rtol=1e-12)
+
+
+def test_syrk(grid42):
+    rng = _rng(8)
+    m, k = 14, 9
+    A = rng.normal(size=(m, k)) + 1j * rng.normal(size=(m, k))
+    out = l3.syrk("L", _dist(grid42, A), nb=8)
+    got = np.asarray(to_global(out))
+    np.testing.assert_allclose(np.tril(got), np.tril(A @ A.T), rtol=1e-12)
+
+
+def test_trrk(grid24):
+    from elemental_tpu import redistribute, VC
+    rng = _rng(9)
+    m, k = 16, 8
+    A = rng.normal(size=(m, k))
+    B = rng.normal(size=(k, m))
+    C0 = rng.normal(size=(m, m))
+    A_mc = redistribute(_dist(grid24, A), MC, STAR)
+    B_mr = redistribute(_dist(grid24, B), STAR, MR)
+    out = l3.trrk("L", -1.0, A_mc, B_mr, 1.0, _dist(grid24, C0))
+    got = np.asarray(to_global(out))
+    np.testing.assert_allclose(np.tril(got), np.tril(C0 - A @ B), rtol=1e-12)
+    np.testing.assert_allclose(np.triu(got, 1), np.triu(C0, 1), rtol=1e-12)
