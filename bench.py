@@ -1,0 +1,103 @@
+"""Driver benchmark: blocked distributed Cholesky TFLOPS on the local chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = measured TFLOP/s / north-star (60% of the chip's fp32-class
+matmul peak; BASELINE.json "north_star").  fp32-class = HIGHEST precision
+(6-pass bf16), so the peak table is bf16-peak / 6.
+
+NOTE on timing: on tunneled devices (axon) ``block_until_ready`` returns
+before remote execution completes, and every host round-trip costs a fixed
+latency.  We force completion with a scalar device->host read and subtract
+the measured round-trip latency of a trivial op.
+"""
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+#: approximate dense-matmul bf16 peaks per chip, TFLOP/s
+_BF16_PEAKS = {
+    "v5 lite": 197.0,    # v5e
+    "v5p": 459.0,
+    "v4": 275.0,
+    "v6": 918.0,
+    "cpu": 0.1,
+}
+
+
+def _fp32_peak(kind: str) -> float:
+    kind = kind.lower()
+    for key, bf16 in _BF16_PEAKS.items():
+        if key in kind:
+            return bf16 / 6.0
+    return 197.0 / 6.0
+
+
+def _roundtrip_latency() -> float:
+    tiny = jax.jit(lambda x: x + 1.0)
+    t = jnp.zeros(())
+    float(tiny(t))
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(tiny(t))
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def main():
+    import elemental_tpu as el
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    n = 16384 if on_tpu else 512
+    nb = 1024 if on_tpu else 64
+    grid = el.Grid([dev])
+
+    rng = np.random.default_rng(0)
+    G = rng.normal(size=(n, n)).astype(np.float32)
+    F = (G @ G.T) / n + n * np.eye(n, dtype=np.float32)
+    A = el.from_global(F, el.MC, el.MR, grid=grid)
+
+    step = jax.jit(lambda a: el.cholesky(a, nb=nb,
+                                         precision=jax.lax.Precision.HIGHEST))
+    L = step(A)
+    float(L.local[0, 0])               # compile + warm (forces completion)
+    lat = _roundtrip_latency()
+
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        L = step(A)
+        float(L.local[0, 0])
+        times.append(time.perf_counter() - t0)
+    dt = max(min(times) - lat, 1e-9)
+
+    flops = n ** 3 / 3
+    tflops = flops / dt / 1e12
+    north_star = 0.6 * _fp32_peak(getattr(dev, "device_kind", dev.platform))
+
+    # sanity: factorization residual (not timed)
+    Lh = np.tril(np.asarray(el.to_global(L)).astype(np.float64))
+    resid = float(np.linalg.norm(F - Lh @ Lh.T) / np.linalg.norm(F))
+    if not np.isfinite(resid) or resid > 1e-2:
+        print(json.dumps({"metric": f"cholesky_n{n}_tflops_per_chip", "value": 0.0,
+                          "unit": "TFLOP/s", "vs_baseline": 0.0,
+                          "error": f"residual {resid:.3e}"}))
+        return 1
+
+    print(json.dumps({
+        "metric": f"cholesky_n{n}_tflops_per_chip",
+        "value": round(tflops, 3),
+        "unit": "TFLOP/s",
+        "vs_baseline": round(tflops / north_star, 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

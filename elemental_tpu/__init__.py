@@ -10,3 +10,7 @@ from .core.distmatrix import DistMatrix, from_global, to_global, zeros
 from .redist.engine import redistribute, transpose_dist
 
 __version__ = "0.1.0"
+
+from . import blas, lapack, matrices
+from .blas import gemm, herk, syrk, trrk, trsm
+from .lapack import cholesky, hpd_solve, cholesky_solve_after

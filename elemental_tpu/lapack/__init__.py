@@ -1,0 +1,3 @@
+"""LAPACK-like layer: factorizations, solves, spectral (growing per
+SURVEY.md §3.4 / §8.2)."""
+from .cholesky import cholesky, hpd_solve, cholesky_solve_after

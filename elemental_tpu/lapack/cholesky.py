@@ -1,0 +1,102 @@
+"""Blocked distributed Cholesky + SPD solve.
+
+Reference: Elemental ``src/lapack_like/factor/Cholesky.cpp`` +
+``Cholesky/LVar3.hpp`` (blocked right-looking lower variant) and
+``src/lapack_like/solve/HPDSolve.cpp`` (Cholesky + two triangular sweeps)
+-- BASELINE.json's headline "SPD Ax=b" config.
+
+Per panel (the LVar3 loop, SURVEY.md §4.2):
+  A11 -> [STAR,STAR]            replicated diagonal block, local potrf
+  A21 -> [VC,STAR]              1-D cyclic panel, local right-Trsm by L11^H
+  L21 -> [MC,STAR]              partial AllGather over mr
+  L21^H -> [STAR,MR]            V-ladder adjoint chain (VC->transpose->MR)
+  A22 -= L21 L21^H (lower tri)  one storage matmul on the MXU, masked
+
+All panel moves are engine fast paths; the trailing update is the
+[MC,STAR] x [STAR,MR] pure-local product (``LocalTrrk``).  Loops are
+Python-unrolled with static shrinking shapes -- no wasted FLOPs on
+already-factored regions (total 1/3 n^3, matching the reference).
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dist import MC, MR, VC, STAR
+from ..core.distmatrix import DistMatrix
+from ..core.view import view, update_view
+from ..redist.engine import redistribute, transpose_dist
+from ..blas.level1 import make_trapezoidal
+from ..blas.level3 import _blocksize, _check_mcmr, _mask_triangle, trsm
+
+
+def cholesky(A: DistMatrix, uplo: str = "L", nb: int | None = None,
+             precision=None) -> DistMatrix:
+    """Cholesky factor of an HPD [MC,MR] matrix; reads only the ``uplo``
+    triangle.  Returns L (A = L L^H) for 'L', U (A = U^H U) for 'U'."""
+    _check_mcmr(A)
+    if uplo.upper().startswith("U"):
+        # U = (lower factor of A^H-as-lower)^H; A hermitian so the data of
+        # the upper triangle, conj-transposed, is the lower triangle.
+        Alow = redistribute(transpose_dist(A, conj=True), MC, MR)
+        L = cholesky(Alow, "L", nb=nb, precision=precision)
+        return redistribute(transpose_dist(L, conj=True), MC, MR)
+
+    m = A.gshape[0]
+    if A.gshape != (m, m):
+        raise ValueError(f"cholesky needs square, got {A.gshape}")
+    g = A.grid
+    r, c = g.height, g.width
+    ib = _blocksize(nb, math.lcm(r, c), m)
+    L = A
+    for s in range(0, m, ib):
+        e = min(s + ib, m)
+        A11 = redistribute(view(L, rows=(s, e), cols=(s, e)), STAR, STAR)
+        # jnp/XLA cholesky symmetrizes its input rather than reading only the
+        # lower triangle; rebuild the Hermitian block from our valid lower part
+        a11 = jnp.tril(A11.local)
+        a11 = a11 + jnp.conj(jnp.tril(a11, -1)).T
+        L11 = jnp.linalg.cholesky(a11)
+        L11_ss = DistMatrix(L11, (e - s, e - s), STAR, STAR, 0, 0, g)
+        L = update_view(L, redistribute(L11_ss, MC, MR), rows=(s, e), cols=(s, e))
+        if e == m:
+            break
+        A21_vc = redistribute(view(L, rows=(e, m), cols=(s, e)), VC, STAR)
+        x21 = lax.linalg.triangular_solve(
+            L11, A21_vc.local, left_side=False, lower=True,
+            transpose_a=True, conjugate_a=True)          # L21 = A21 L11^{-H}
+        L21_vc = DistMatrix(x21, (m - e, e - s), VC, STAR, 0, 0, g)
+        L21_mc = redistribute(L21_vc, MC, STAR)
+        L21H_mr = redistribute(transpose_dist(L21_vc, conj=True), STAR, MR)
+        A22 = view(L, rows=(e, m), cols=(e, m))
+        upd = jnp.matmul(L21_mc.local, L21H_mr.local, precision=precision)
+        mask = _mask_triangle(A22, "L")
+        A22new = jnp.where(mask, A22.local - upd.astype(L.dtype), A22.local)
+        L = update_view(L, A22.with_local(A22new), rows=(e, m), cols=(e, m))
+        L = update_view(L, redistribute(L21_mc, MC, MR), rows=(e, m), cols=(s, e))
+    return make_trapezoidal(L, "L")
+
+
+def hpd_solve(A: DistMatrix, B: DistMatrix, uplo: str = "L",
+              nb: int | None = None, precision=None) -> DistMatrix:
+    """Solve A X = B for HPD A: Cholesky + forward/backward sweeps
+    (``El::HPDSolve``, ``src/lapack_like/solve/HPDSolve.cpp``)."""
+    if uplo.upper().startswith("U"):
+        U = cholesky(A, "U", nb=nb, precision=precision)
+        Y = trsm("L", "U", "C", U, B, nb=nb, precision=precision)
+        return trsm("L", "U", "N", U, Y, nb=nb, precision=precision)
+    L = cholesky(A, "L", nb=nb, precision=precision)
+    Y = trsm("L", "L", "N", L, B, nb=nb, precision=precision)
+    return trsm("L", "L", "C", L, Y, nb=nb, precision=precision)
+
+
+def cholesky_solve_after(L: DistMatrix, B: DistMatrix, uplo: str = "L",
+                         nb: int | None = None, precision=None) -> DistMatrix:
+    """Re-use an existing factor (``cholesky::SolveAfter``)."""
+    if uplo.upper().startswith("U"):
+        Y = trsm("L", "U", "C", L, B, nb=nb, precision=precision)
+        return trsm("L", "U", "N", L, Y, nb=nb, precision=precision)
+    Y = trsm("L", "L", "N", L, B, nb=nb, precision=precision)
+    return trsm("L", "L", "C", L, Y, nb=nb, precision=precision)

@@ -1,0 +1,5 @@
+"""Matrix gallery (reference: Elemental ``src/matrices/``)."""
+from .basic import (
+    zeros, ones, identity, hilbert, lehmer, minij,
+    uniform, gaussian, hermitian_uniform_spectrum,
+)

@@ -1,0 +1,91 @@
+"""Matrix gallery (initial slice).
+
+Reference: Elemental ``src/matrices/**`` (~60 deterministic + random
+generators, the test/benchmark input factory).  Deterministic generators are
+built on the level-1 index-dependent fill (device-side, layout-independent);
+random generators draw on the host for cross-layout determinism and enter
+through ``from_global`` (the gallery widens in the breadth pass).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dist import Dist, MC, MR
+from ..core.distmatrix import DistMatrix, from_global, zeros as dm_zeros
+from ..core.grid import Grid, default_grid
+from ..blas.level1 import index_dependent_fill, shift_diagonal
+
+
+def _empty(m, n, grid, dtype, cdist=MC, rdist=MR):
+    return dm_zeros(m, n, cdist, rdist, grid, dtype=dtype)
+
+
+def zeros(m: int, n: int | None = None, grid: Grid | None = None, dtype=jnp.float32):
+    return _empty(m, n or m, grid or default_grid(), dtype)
+
+
+def ones(m: int, n: int | None = None, grid: Grid | None = None, dtype=jnp.float32):
+    from ..blas.level1 import fill
+    return fill(_empty(m, n or m, grid or default_grid(), dtype), 1)
+
+
+def identity(m: int, n: int | None = None, grid: Grid | None = None, dtype=jnp.float32):
+    A = _empty(m, n or m, grid or default_grid(), dtype)
+    return shift_diagonal(A, 1)
+
+
+def hilbert(n: int, grid: Grid | None = None, dtype=jnp.float64):
+    A = _empty(n, n, grid or default_grid(), dtype)
+    return index_dependent_fill(A, lambda i, j: (1.0 / (i + j + 1)).astype(dtype))
+
+
+def lehmer(n: int, grid: Grid | None = None, dtype=jnp.float64):
+    A = _empty(n, n, grid or default_grid(), dtype)
+    return index_dependent_fill(
+        A, lambda i, j: (jnp.minimum(i, j) + 1).astype(dtype)
+        / (jnp.maximum(i, j) + 1))
+
+
+def minij(n: int, grid: Grid | None = None, dtype=jnp.float64):
+    A = _empty(n, n, grid or default_grid(), dtype)
+    return index_dependent_fill(A, lambda i, j: (jnp.minimum(i, j) + 1).astype(dtype))
+
+
+# ---- random ----------------------------------------------------------
+
+def uniform(m: int, n: int | None = None, grid: Grid | None = None,
+            dtype=jnp.float32, seed: int = 0, lo=0.0, hi=1.0) -> DistMatrix:
+    n = n or m
+    rng = np.random.default_rng(seed)
+    F = rng.uniform(lo, hi, size=(m, n)).astype(np.dtype(dtype))
+    return from_global(F, MC, MR, grid or default_grid())
+
+
+def gaussian(m: int, n: int | None = None, grid: Grid | None = None,
+             dtype=jnp.float32, seed: int = 0) -> DistMatrix:
+    n = n or m
+    rng = np.random.default_rng(seed)
+    dt = np.dtype(dtype)
+    if np.issubdtype(dt, np.complexfloating):
+        F = (rng.normal(size=(m, n)) + 1j * rng.normal(size=(m, n))).astype(dt)
+    else:
+        F = rng.normal(size=(m, n)).astype(dt)
+    return from_global(F, MC, MR, grid or default_grid())
+
+
+def hermitian_uniform_spectrum(n: int, lo=1.0, hi=2.0, grid: Grid | None = None,
+                               dtype=jnp.float64, seed: int = 0) -> DistMatrix:
+    """HPD test matrix with known-conditioned uniform spectrum
+    (``El::HermitianUniformSpectrum``): Q diag(u) Q^H, Q Haar via QR."""
+    rng = np.random.default_rng(seed)
+    dt = np.dtype(dtype)
+    if np.issubdtype(dt, np.complexfloating):
+        G = rng.normal(size=(n, n)) + 1j * rng.normal(size=(n, n))
+    else:
+        G = rng.normal(size=(n, n))
+    Q, _ = np.linalg.qr(G)
+    d = rng.uniform(lo, hi, size=n)
+    A = (Q * d) @ Q.conj().T
+    A = (A + A.conj().T) / 2
+    return from_global(A.astype(dt), MC, MR, grid or default_grid())

@@ -1,0 +1,84 @@
+"""Cholesky / HPDSolve residual oracles.
+
+Mirrors the reference's ``tests/lapack_like/Cholesky.cpp``: factor a
+known-conditioned HPD matrix (HermitianUniformSpectrum), check
+  ||A - L L^H||_F / ||A||_F  and solve residuals  ||A X - B|| / ||B||.
+"""
+import numpy as np
+import pytest
+
+import elemental_tpu as el
+from elemental_tpu import MC, MR, from_global, to_global
+from elemental_tpu.matrices import hermitian_uniform_spectrum
+from elemental_tpu.blas.level1 import frobenius_norm
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_cholesky_residual(grid24, uplo, dtype):
+    n = 28
+    A = hermitian_uniform_spectrum(n, 1, 10, grid24, dtype=dtype, seed=3)
+    F = np.asarray(to_global(A))
+    L = el.cholesky(A, uplo=uplo, nb=8)
+    Lh = np.asarray(to_global(L))
+    if uplo == "L":
+        assert np.allclose(np.triu(Lh, 1), 0)
+        resid = np.linalg.norm(F - Lh @ Lh.conj().T) / np.linalg.norm(F)
+    else:
+        assert np.allclose(np.tril(Lh, -1), 0)
+        resid = np.linalg.norm(F - Lh.conj().T @ Lh) / np.linalg.norm(F)
+    assert resid < 1e-13
+
+
+def test_cholesky_reads_only_triangle(grid42):
+    n = 16
+    A = hermitian_uniform_spectrum(n, 1, 5, grid42, dtype=np.float64, seed=4)
+    F = np.asarray(to_global(A))
+    garbage = F + np.triu(np.random.default_rng(0).normal(size=(n, n)), 1)
+    Ld = el.cholesky(from_global(garbage, MC, MR, grid42), "L", nb=8)
+    want = np.linalg.cholesky(F)
+    np.testing.assert_allclose(np.asarray(to_global(Ld)), want, rtol=1e-10)
+
+
+def test_cholesky_any_grid_ragged(any_grid):
+    n = 19     # deliberately not a multiple of any grid dim
+    A = hermitian_uniform_spectrum(n, 1, 4, any_grid, dtype=np.float64, seed=5)
+    F = np.asarray(to_global(A))
+    L = np.asarray(to_global(el.cholesky(A, nb=8)))
+    assert np.linalg.norm(F - L @ L.T) / np.linalg.norm(F) < 1e-13
+
+
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_hpd_solve(grid24, uplo):
+    n, nrhs = 24, 7
+    A = hermitian_uniform_spectrum(n, 1, 8, grid24, dtype=np.complex128, seed=6)
+    F = np.asarray(to_global(A))
+    rng = np.random.default_rng(7)
+    B = rng.normal(size=(n, nrhs)) + 1j * rng.normal(size=(n, nrhs))
+    X = el.hpd_solve(A, from_global(B, MC, MR, grid24), uplo=uplo, nb=8)
+    Xh = np.asarray(to_global(X))
+    assert np.linalg.norm(F @ Xh - B) / np.linalg.norm(B) < 1e-12
+
+
+def test_cholesky_solve_after(grid24):
+    n, nrhs = 20, 3
+    A = hermitian_uniform_spectrum(n, 1, 6, grid24, dtype=np.float64, seed=8)
+    F = np.asarray(to_global(A))
+    L = el.cholesky(A, nb=8)
+    B = np.random.default_rng(9).normal(size=(n, nrhs))
+    X = el.cholesky_solve_after(L, from_global(B, MC, MR, grid24), nb=8)
+    assert np.linalg.norm(F @ np.asarray(to_global(X)) - B) < 1e-11 * np.linalg.norm(B)
+
+
+def test_matrix_gallery(grid24):
+    from elemental_tpu.matrices import identity, ones, hilbert, lehmer, minij
+    n = 11
+    np.testing.assert_allclose(np.asarray(to_global(identity(n, grid=grid24))), np.eye(n))
+    np.testing.assert_allclose(np.asarray(to_global(ones(n, grid=grid24))), np.ones((n, n)))
+    H = np.asarray(to_global(hilbert(n, grid24)))
+    i, j = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
+    np.testing.assert_allclose(H, 1.0 / (i + j + 1))
+    np.testing.assert_allclose(np.asarray(to_global(lehmer(n, grid24))),
+                               (np.minimum(i, j) + 1.0) / (np.maximum(i, j) + 1.0))
+    np.testing.assert_allclose(np.asarray(to_global(minij(n, grid24))),
+                               np.minimum(i, j) + 1.0)
