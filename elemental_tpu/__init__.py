@@ -14,3 +14,4 @@ __version__ = "0.1.0"
 from . import blas, lapack, matrices
 from .blas import gemm, herk, syrk, trrk, trsm
 from .lapack import cholesky, hpd_solve, cholesky_solve_after
+from .lapack import lu, lu_solve, lu_solve_after, permute_rows

@@ -303,9 +303,12 @@ def _trsm_left(uplo: str, trans: bool, conj: bool, A: DistMatrix, B: DistMatrix,
     for s in starts:
         e = min(s + ib, m)
         A11 = redistribute(view(A, rows=(s, e), cols=(s, e)), STAR, STAR)
+        # mask to the stored triangle so opposite-triangle garbage (e.g. the
+        # packed L\U format of lu()) can never leak into the solve
+        a11 = jnp.tril(A11.local) if lower else jnp.triu(A11.local)
         B1 = redistribute(view(X, rows=(s, e)), STAR, VR)
         x1 = lax.linalg.triangular_solve(
-            A11.local, B1.local, left_side=True, lower=lower,
+            a11, B1.local, left_side=True, lower=lower,
             transpose_a=trans, conjugate_a=conj, unit_diagonal=unit)
         X1 = DistMatrix(x1, B1.gshape, STAR, VR, 0, 0, A.grid)
         X1_mr = redistribute(X1, STAR, MR)

@@ -1,3 +1,4 @@
 """LAPACK-like layer: factorizations, solves, spectral (growing per
 SURVEY.md §3.4 / §8.2)."""
 from .cholesky import cholesky, hpd_solve, cholesky_solve_after
+from .lu import lu, lu_solve, lu_solve_after, permute_rows

@@ -1,0 +1,212 @@
+"""LU with partial pivoting (HPL-style) + permutation utilities.
+
+Reference: Elemental ``src/lapack_like/factor/LU.cpp`` +
+``LU/{Panel,SolveAfter}.hpp`` and ``src/lapack_like/perm/`` (DistPermutation,
+ApplyRowPivots) -- BASELINE.json's "LU with partial pivoting" config.
+
+TPU-first redesign of the panel (SURVEY.md §4.4 / §8.3 item 2): the
+reference's ``lu::Panel`` runs one MAXLOC AllReduce + one SendRecv PER
+COLUMN -- a latency wall.  Here the whole current panel is gathered to
+[STAR,STAR] (one collective) and factored REDUNDANTLY on every device with
+a local ``lax.fori_loop``: identical deterministic results everywhere, so
+pivot search costs zero communication.  Pivot row swaps touch only the
+<= 2*nb affected global rows, applied with traced gather/scatter on the
+storage array (the analog of HPL's row-broadcast swap).
+
+Data-dependent pivots are traced values, so the whole factorization jits;
+the packed L\\U layout and the permutation-vector convention follow LAPACK
+getrf (perm[i] = original index of the row now at position i).
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dist import MC, MR, STAR, VR
+from ..core.distmatrix import DistMatrix
+from ..core.view import view, update_view
+from ..redist.engine import redistribute
+from ..blas.level3 import _blocksize, _check_mcmr, trsm
+
+
+# ---------------------------------------------------------------------
+# permutation utilities (the DistPermutation analog)
+# ---------------------------------------------------------------------
+
+def permute_rows(B: DistMatrix, perm, inverse: bool = False) -> DistMatrix:
+    """B[perm, :] as a DistMatrix (``DistPermutation::PermuteRows``).
+
+    Rides [STAR,VR]: rows replicated there, so the traced-index gather is
+    pure-local; two engine hops re-land [MC,MR]."""
+    _check_mcmr(B)
+    Bvr = redistribute(B, STAR, VR)
+    p = jnp.argsort(perm) if inverse else perm
+    out = Bvr.with_local(Bvr.local[p, :])
+    return redistribute(out, MC, MR)
+
+
+def _storage_row(i, r: int, lr: int):
+    """Storage row of global row i for a stride-r zero-aligned dim."""
+    if r == 1:
+        return i
+    return (i % r) * lr + i // r
+
+
+def _apply_swaps_storage(A: DistMatrix, T, pstep) -> DistMatrix:
+    """Apply a swap-composed permutation ``pstep`` to A's rows, touching only
+    the affected positions ``T`` (gather + scatter of <= 2*nb rows).
+    Duplicate entries in T scatter identical rows, so they are safe."""
+    content = pstep[T]
+    r, lr = A.col_stride, A.local_rows
+    sidx = _storage_row(T, r, lr)
+    gsrc = _storage_row(content, r, lr)
+    stor = A.local
+    rows = jnp.take(stor, gsrc, axis=0)
+    return A.with_local(stor.at[sidx].set(rows))
+
+
+def _swaps_to_perm(m: int, dests, srcs):
+    """Compose sequential swaps into a permutation vector (traced)."""
+    perm = jnp.arange(m)
+
+    def body(j, p):
+        d, sr = dests[j], srcs[j]
+        pd, ps = p[d], p[sr]
+        return p.at[d].set(ps).at[sr].set(pd)
+
+    return lax.fori_loop(0, dests.shape[0], body, perm)
+
+
+# ---------------------------------------------------------------------
+# replicated panel factorization
+# ---------------------------------------------------------------------
+
+def _panel_lu(P, nbw: int):
+    """Unblocked partial-pivot LU of a replicated (M, nbw) panel.
+
+    Runs identically on every device (replicated input, deterministic) --
+    the TPU answer to ``lu::Panel``'s per-column MAXLOC+SendRecv.
+    Returns (packed L\\U panel, pivot row indices within the panel)."""
+    M = P.shape[0]
+    ridx = jnp.arange(M)
+    cidx = jnp.arange(nbw)
+
+    def body(j, state):
+        P, piv = state
+        col = P[:, j]
+        cand = jnp.where(ridx >= j, jnp.abs(col), -jnp.inf)
+        p = jnp.argmax(cand)
+        piv = piv.at[j].set(p.astype(piv.dtype))
+        rowj = P[j]
+        rowp = P[p]
+        P = P.at[j].set(rowp).at[p].set(rowj)
+        pivval = P[j, j]
+        l = jnp.where(ridx > j, P[:, j] / pivval, jnp.zeros_like(col))
+        P = P.at[:, j].set(jnp.where(ridx > j, l, P[:, j]))
+        urow = jnp.where(cidx > j, P[j], jnp.zeros_like(P[j]))
+        P = P - jnp.outer(l, urow)
+        return P, piv
+
+    piv0 = jnp.zeros((nbw,), jnp.int32)
+    return lax.fori_loop(0, nbw, body, (P, piv0))
+
+
+# ---------------------------------------------------------------------
+# blocked right-looking LU
+# ---------------------------------------------------------------------
+
+def lu(A: DistMatrix, nb: int | None = None, precision=None):
+    """Blocked right-looking LU with partial pivoting.
+
+    Returns (LU, perm): LU holds unit-lower L below the diagonal and U on
+    and above it (LAPACK getrf packing); perm is a traced length-m vector
+    with perm[i] = original index of the row now at position i, so
+    ``P A = L U`` with ``(P A)[i] = A[perm[i]]``."""
+    _check_mcmr(A)
+    m, n = A.gshape
+    g = A.grid
+    r, c = g.height, g.width
+    ib = _blocksize(nb, math.lcm(r, c), min(m, n))
+    kend = min(m, n)
+    perm = jnp.arange(m)
+    for s in range(0, kend, ib):
+        e = min(s + ib, kend)
+        nbw = e - s
+        # Views must start/end on stride boundaries; a ragged diagonal end
+        # (wide matrices, e == m not stride-aligned) is handled by widening
+        # every view to a legal boundary and column-masking the writebacks.
+        e_up = min(-(-e // c) * c, n)
+        panel = redistribute(view(A, rows=(s, m), cols=(s, e_up)), STAR, STAR)
+        Pf, piv = _panel_lu(panel.local[:, :nbw], nbw)
+        piv_g = piv.astype(jnp.int32) + s                # global pivot rows
+        dests = jnp.arange(s, e, dtype=jnp.int32)
+        pstep = _swaps_to_perm(m, dests, piv_g)
+        perm = perm[pstep]
+        # swap the affected rows across ALL columns (the panel region is
+        # overwritten by the factored panel right after)
+        A = _apply_swaps_storage(A, jnp.concatenate([dests, piv_g]), pstep)
+        # write back the factored panel (rows s..m of cols s..e)
+        if e_up > e:
+            Pf_w = jnp.pad(Pf, ((0, 0), (0, e_up - e)))
+        else:
+            Pf_w = Pf
+        Pf_ss = DistMatrix(Pf_w, (m - s, e_up - s), STAR, STAR, 0, 0, g)
+        A = _update_cols_lt(A, redistribute(Pf_ss, MC, MR), (s, m), (s, e_up), e)
+        # U12 := L11^{-1} A12 ; A22 -= L21 U12.  The solve runs over the full
+        # legal column range (s, n) and the writeback keeps only cols >= e.
+        if e < n:
+            L11 = jnp.tril(Pf[:nbw, :], -1) + jnp.eye(nbw, dtype=Pf.dtype)
+            A1n = redistribute(view(A, rows=(s, e), cols=(s, n)), STAR, VR)
+            u1n = lax.linalg.triangular_solve(
+                L11, A1n.local, left_side=True, lower=True, unit_diagonal=True)
+            U1n = DistMatrix(u1n, (nbw, n - s), STAR, VR, 0, 0, g)
+            U1n_mr = redistribute(U1n, STAR, MR)
+            A = _update_cols_ge(A, redistribute(U1n_mr, MC, MR), (s, e), (s, n), e)
+            if e < m:      # only non-final panels: e is stride-aligned here
+                U12_mr = view(U1n_mr, cols=(e - s, n - s))
+                L21_ss = DistMatrix(Pf[nbw:, :], (m - e, nbw), STAR, STAR, 0, 0, g)
+                L21_mc = redistribute(L21_ss, MC, STAR)
+                upd = jnp.matmul(L21_mc.local, U12_mr.local, precision=precision)
+                A22 = view(A, rows=(e, m), cols=(e, n))
+                A = update_view(A, A22.with_local(A22.local - upd.astype(A.dtype)),
+                                rows=(e, m), cols=(e, n))
+    return A, perm
+
+
+def _blend_update(A: DistMatrix, block: DistMatrix, rows, cols, keep_new):
+    from ..blas.level1 import _global_indices
+    cur = view(A, rows=rows, cols=cols)
+    I, J = _global_indices(cur)
+    mask = keep_new(J)[None, :]
+    return update_view(A, cur.with_local(jnp.where(mask, block.local, cur.local)),
+                       rows=rows, cols=cols)
+
+
+def _update_cols_lt(A, block, rows, cols, e):
+    """Write ``block`` into the view, only at global columns < e."""
+    if cols[1] == e:
+        return update_view(A, block, rows=rows, cols=cols)
+    return _blend_update(A, block, rows, cols, lambda J: J < e - cols[0])
+
+
+def _update_cols_ge(A, block, rows, cols, e):
+    """Write ``block`` into the view, only at global columns >= e."""
+    return _blend_update(A, block, rows, cols, lambda J: J >= e - cols[0])
+
+
+def lu_solve(A: DistMatrix, B: DistMatrix, nb: int | None = None,
+             precision=None) -> DistMatrix:
+    """Solve A X = B via LU with partial pivoting (``El::LinearSolve``,
+    ``src/lapack_like/solve/LinearSolve.cpp``: LU + SolveAfter)."""
+    LU_, perm = lu(A, nb=nb, precision=precision)
+    return lu_solve_after(LU_, perm, B, nb=nb, precision=precision)
+
+
+def lu_solve_after(LU_: DistMatrix, perm, B: DistMatrix, nb: int | None = None,
+                   precision=None) -> DistMatrix:
+    """X = U^{-1} L^{-1} P B (``lu::SolveAfter``)."""
+    Bp = permute_rows(B, perm)
+    Y = trsm("L", "L", "N", LU_, Bp, unit=True, nb=nb, precision=precision)
+    return trsm("L", "U", "N", LU_, Y, nb=nb, precision=precision)
