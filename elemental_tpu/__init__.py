@@ -15,3 +15,4 @@ from . import blas, lapack, matrices
 from .blas import gemm, herk, syrk, trrk, trsm
 from .lapack import cholesky, hpd_solve, cholesky_solve_after
 from .lapack import lu, lu_solve, lu_solve_after, permute_rows
+from .lapack import qr, apply_q, explicit_q, least_squares, tsqr

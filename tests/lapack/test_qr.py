@@ -1,0 +1,98 @@
+"""QR / ApplyQ / TSQR / LeastSquares oracles.
+
+Mirrors ``tests/lapack_like/QR.cpp``: factorization residual ||A - QR||,
+orthogonality ||I - Q^H Q||, solve residuals (SURVEY.md §5).
+"""
+import numpy as np
+import pytest
+
+import elemental_tpu as el
+from elemental_tpu import MC, MR, VC, STAR, from_global, to_global, redistribute
+from elemental_tpu.lapack.qr import qr, apply_q, explicit_q, least_squares, tsqr
+
+
+def _dist(g, arr):
+    return from_global(arr, MC, MR, grid=g)
+
+
+@pytest.mark.parametrize("shape", [(24, 24), (32, 16), (16, 32), (19, 13), (13, 19)])
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_qr_residual_orthogonality(grid24, shape, dtype):
+    m, n = shape
+    rng = np.random.default_rng(21)
+    F = rng.normal(size=(m, n)).astype(dtype)
+    if np.issubdtype(dtype, np.complexfloating):
+        F = F + 1j * rng.normal(size=(m, n))
+    Ap, tau = qr(_dist(grid24, F), nb=8)
+    Q = np.asarray(to_global(explicit_q(Ap, tau, nb=8)))
+    k = min(m, n)
+    R = np.triu(np.asarray(to_global(Ap)))[:k, :]
+    assert np.linalg.norm(np.eye(m) - Q.conj().T @ Q) < 1e-12 * m
+    assert np.linalg.norm(F - Q[:, :k] @ R) / np.linalg.norm(F) < 1e-13
+
+
+def test_qr_vs_numpy_R(grid42):
+    m, n = 20, 12
+    rng = np.random.default_rng(22)
+    F = rng.normal(size=(m, n))
+    Ap, tau = qr(_dist(grid42, F), nb=8)
+    R = np.triu(np.asarray(to_global(Ap)))[:n, :]
+    Rnp = np.linalg.qr(F, mode="r")
+    np.testing.assert_allclose(np.abs(R), np.abs(Rnp), atol=1e-12)
+
+
+def test_apply_q_adjoint_roundtrip(grid24):
+    m, n, nrhs = 24, 16, 5
+    rng = np.random.default_rng(23)
+    F = rng.normal(size=(m, n)) + 1j * rng.normal(size=(m, n))
+    B = rng.normal(size=(m, nrhs)) + 1j * rng.normal(size=(m, nrhs))
+    Ap, tau = qr(_dist(grid24, F), nb=8)
+    Bd = _dist(grid24, B)
+    out = apply_q(Ap, tau, apply_q(Ap, tau, Bd, orient="C", nb=8),
+                  orient="N", nb=8)
+    np.testing.assert_allclose(np.asarray(to_global(out)), B, atol=1e-12)
+
+
+@pytest.mark.parametrize("shape", [(32, 8), (40, 12)])
+def test_least_squares(grid24, shape):
+    m, n = shape
+    rng = np.random.default_rng(24)
+    F = rng.normal(size=(m, n))
+    B = rng.normal(size=(m, 3))
+    X = least_squares(_dist(grid24, F), _dist(grid24, B), nb=8)
+    Xnp, *_ = np.linalg.lstsq(F, B, rcond=None)
+    np.testing.assert_allclose(np.asarray(to_global(X)), Xnp, atol=1e-10)
+
+
+def test_least_squares_complex_any_grid(any_grid):
+    m, n = 26, 7
+    rng = np.random.default_rng(25)
+    F = rng.normal(size=(m, n)) + 1j * rng.normal(size=(m, n))
+    B = rng.normal(size=(m, 2)) + 1j * rng.normal(size=(m, 2))
+    X = least_squares(_dist(any_grid, F), _dist(any_grid, B), nb=4)
+    Xnp, *_ = np.linalg.lstsq(F, B, rcond=None)
+    np.testing.assert_allclose(np.asarray(to_global(X)), Xnp, atol=1e-10)
+
+
+def test_tsqr(grid24):
+    m, k = 64, 6
+    rng = np.random.default_rng(26)
+    F = rng.normal(size=(m, k))
+    A = from_global(F, VC, STAR, grid24)
+    Q, R = tsqr(A)
+    Qh = np.asarray(to_global(Q))
+    Rh = np.asarray(to_global(R))
+    assert np.linalg.norm(Qh.T @ Qh - np.eye(k)) < 1e-13
+    np.testing.assert_allclose(Qh @ Rh, F, atol=1e-12)
+    assert np.allclose(np.tril(Rh, -1), 0)
+
+
+def test_qr_jit(grid24):
+    import jax
+    m, n = 16, 12
+    rng = np.random.default_rng(27)
+    F = rng.normal(size=(m, n))
+    Ap, tau = jax.jit(lambda a: qr(a, nb=8))(_dist(grid24, F))
+    R = np.triu(np.asarray(to_global(Ap)))[:n, :]
+    Rnp = np.linalg.qr(F, mode="r")
+    np.testing.assert_allclose(np.abs(R), np.abs(Rnp), atol=1e-12)
