@@ -27,6 +27,7 @@ fall back otherwise.
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -411,10 +412,15 @@ def _scatter_sum_dim(x, dim: int, axis_name: str, S: int, l_out: int):
 # public jit-able wrapper
 # ---------------------------------------------------------------------
 
+@partial(jax.jit, static_argnums=(1, 2, 3, 4))
 def redistribute(A: DistMatrix, cdist: Dist, rdist: Dist,
                  calign: int = 0, ralign: int = 0) -> DistMatrix:
     """B[cdist,rdist] = A, as a standalone (jit-able) op on storage-form
-    DistMatrix.  ``Copy(A, B)`` / ``operator=`` of the reference."""
+    DistMatrix.  ``Copy(A, B)`` / ``operator=`` of the reference.
+
+    jit-cached on (static metadata, dst dists, aligns): eager callers (tests,
+    blocked loops run outside an enclosing jit) hit the compile cache instead
+    of re-tracing a fresh ``shard_map`` closure per call."""
     _check_pair(cdist, rdist)
     out_meta = DistMatrix(None, A.gshape, cdist, rdist, calign, ralign, A.grid)
 
