@@ -12,7 +12,10 @@ from .redist.engine import redistribute, transpose_dist
 __version__ = "0.1.0"
 
 from . import blas, lapack, matrices
-from .blas import gemm, herk, syrk, trrk, trsm
+from .blas import (gemm, herk, syrk, trrk, trsm, trr2k, her2k, syr2k,
+                   hemm, symm, trmm, two_sided_trsm, two_sided_trmm,
+                   multishift_trsm)
+from .blas import gemv, ger, hemv, symv, her2, trmv, trsv
 from .lapack import cholesky, hpd_solve, cholesky_solve_after
 from .lapack import lu, lu_solve, lu_solve_after, permute_rows
 from .lapack import qr, apply_q, explicit_q, least_squares, tsqr

@@ -1,3 +1,6 @@
 """BLAS-like layer (reference: Elemental ``src/blas_like/``)."""
 from . import level1
-from .level3 import gemm, herk, syrk, trrk, trsm
+from .level2 import gemv, ger, hemv, symv, her2, trmv, trsv
+from .level3 import (gemm, herk, syrk, trrk, trsm, trr2k, her2k, syr2k,
+                     hemm, symm, trmm, two_sided_trsm, two_sided_trmm,
+                     multishift_trsm)

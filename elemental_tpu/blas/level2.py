@@ -1,0 +1,167 @@
+"""Level-2 BLAS: distributed matrix-vector operations.
+
+Reference: Elemental ``src/blas_like/level2/`` -- ``Gemv`` (panel
+redistributions + SumScatter), ``Ger``, ``Symv``/``Hemv`` (the
+tridiagonalization workhorse, accumulating [MC,STAR] and [MR,STAR]
+partials), ``Trsv``/``Trmv``.
+
+TPU-native design: a vector is an (m, 1) zero-aligned [MC,MR] DistMatrix.
+Because the stacked-storage array of a DistMatrix is an index PERMUTATION of
+the global matrix (P_mc A P_mr^T), a matvec is a single storage-level matmul
+between compatibly-permuted operands, and GSPMD lowers the sharded
+contraction to a local MXU product plus the one collective the reference
+hand-codes (psum over 'mr' for N, over 'mc' for T/C -- the AllGather +
+local-gemv + ReduceScatter of ``El::Gemv``):
+
+  N:  y_stor[MC,STAR] = A_stor @ x_stor[MR,STAR]     (contraction mr-sharded)
+  T:  y_stor[MR,STAR] = A_stor^T @ x_stor[MC,STAR]   (contraction mc-sharded)
+
+``hemv``/``symv`` read only the stored triangle: the strictly-off-triangle
+product rides the transposed path, so exactly one triangle of A is touched
+(matching the reference's one-triangle access guarantee).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dist import MC, MR, STAR
+from ..core.distmatrix import DistMatrix
+from ..redist.engine import redistribute
+from .level3 import _check_mcmr, _mask_triangle, _safe_astype, _nonzero, trsm
+
+
+def _check_vector(x: DistMatrix, extent: int, what: str):
+    if x.gshape != (extent, 1):
+        raise ValueError(f"{what} must be ({extent}, 1), got {x.gshape}")
+
+
+def _axpby(alpha, prod_mcmr: DistMatrix, beta, y: DistMatrix | None,
+           like: DistMatrix):
+    if y is None:
+        return prod_mcmr.with_local(_safe_astype(alpha * prod_mcmr.local, like.dtype))
+    newloc = alpha * prod_mcmr.local + (beta * y.local if _nonzero(beta) else 0)
+    return y.with_local(_safe_astype(newloc, y.dtype))
+
+
+def _matvec_n(A_local, x: DistMatrix, m: int, grid, precision):
+    """op = N storage matvec: returns the [MC,STAR] (m,1) partial-free result."""
+    x_mr = redistribute(x, MR, STAR)
+    y = jnp.matmul(A_local, x_mr.local, precision=precision)
+    return DistMatrix(y, (m, 1), MC, STAR, 0, 0, grid)
+
+
+def _matvec_t(A_local, x: DistMatrix, n: int, grid, conj: bool, precision):
+    """op = T/C storage matvec: returns the [MR,STAR] (n,1) result."""
+    x_mc = redistribute(x, MC, STAR)
+    a = jnp.conj(A_local) if conj else A_local
+    y = jnp.matmul(a.T, x_mc.local, precision=precision)
+    return DistMatrix(y, (n, 1), MR, STAR, 0, 0, grid)
+
+
+def gemv(A: DistMatrix, x: DistMatrix, alpha=1.0, beta=0.0,
+         y: DistMatrix | None = None, orient: str = "N",
+         precision=None) -> DistMatrix:
+    """y := alpha op(A) x + beta y (``El::Gemv``)."""
+    _check_mcmr(A)
+    m, n = A.gshape
+    if orient == "N":
+        _check_vector(x, n, "x")
+        prod = redistribute(_matvec_n(A.local, x, m, A.grid, precision), MC, MR)
+    else:
+        _check_vector(x, m, "x")
+        prod = redistribute(
+            _matvec_t(A.local, x, n, A.grid, orient == "C", precision), MC, MR)
+    return _axpby(alpha, prod, beta, y, A)
+
+
+def ger(alpha, x: DistMatrix, y: DistMatrix, A: DistMatrix,
+        conj: bool = True, precision=None) -> DistMatrix:
+    """A := A + alpha x y^H (``El::Ger``; ``conj=False`` gives ``Geru``).
+
+    Outer product of the [MC,STAR] column and [STAR,MR] row storage forms --
+    a pure-local rank-1 update, zero communication beyond the two panel
+    moves (exactly the reference's Ger data motion)."""
+    _check_mcmr(A)
+    m, n = A.gshape
+    _check_vector(x, m, "x")
+    _check_vector(y, n, "y")
+    x_mc = redistribute(x, MC, STAR)
+    y_mr = redistribute(y, MR, STAR)
+    row = jnp.conj(y_mr.local).T if conj else y_mr.local.T
+    upd = jnp.matmul(x_mc.local, row, precision=precision)
+    return A.with_local(_safe_astype(A.local + alpha * upd, A.dtype))
+
+
+def hemv(uplo: str, A: DistMatrix, x: DistMatrix, alpha=1.0, beta=0.0,
+         y: DistMatrix | None = None, conj: bool = True,
+         precision=None) -> DistMatrix:
+    """y := alpha A x + beta y for Hermitian A stored in the ``uplo``
+    triangle (``El::Hemv``; ``conj=False`` = ``Symv``).
+
+    Split A = T + S^H where T is the stored (full) triangle and S the
+    strict triangle's transpose image: T x rides the N path, S^H x = the
+    transposed path on the strict triangle -- both touch ONLY stored
+    entries.  The two partial results land [MC,STAR] and [MR,STAR] (the
+    reference's two accumulators) and meet on [MC,MR]."""
+    _check_mcmr(A)
+    n = A.gshape[0]
+    if A.gshape != (n, n):
+        raise ValueError(f"hemv needs square A, got {A.gshape}")
+    _check_vector(x, n, "x")
+    tri = _mask_triangle(A, uplo)
+    strict = _mask_triangle(A, uplo, strict=True)
+    T = jnp.where(tri, A.local, 0)
+    S = jnp.where(strict, A.local, 0)
+    p1 = redistribute(_matvec_n(T, x, n, A.grid, precision), MC, MR)
+    p2 = redistribute(_matvec_t(S, x, n, A.grid, conj, precision), MC, MR)
+    prod = p1.with_local(p1.local + p2.local)
+    return _axpby(alpha, prod, beta, y, A)
+
+
+def symv(uplo: str, A: DistMatrix, x: DistMatrix, alpha=1.0, beta=0.0,
+         y: DistMatrix | None = None, precision=None) -> DistMatrix:
+    return hemv(uplo, A, x, alpha, beta, y, conj=False, precision=precision)
+
+
+def her2(uplo: str, alpha, x: DistMatrix, y: DistMatrix, A: DistMatrix,
+         conj: bool = True, precision=None) -> DistMatrix:
+    """A(tri) += alpha x y^H + conj(alpha) y x^H (``El::Her2``/``Syr2``)."""
+    _check_mcmr(A)
+    n = A.gshape[0]
+    _check_vector(x, n, "x")
+    _check_vector(y, n, "y")
+    x_mc = redistribute(x, MC, STAR)
+    y_mc = redistribute(y, MC, STAR)
+    x_mr = redistribute(x, MR, STAR)
+    y_mr = redistribute(y, MR, STAR)
+
+    def _t(v):
+        return (jnp.conj(v.local) if conj else v.local).T
+
+    a2 = jnp.conj(alpha) if conj else alpha
+    upd = alpha * jnp.matmul(x_mc.local, _t(y_mr), precision=precision) \
+        + a2 * jnp.matmul(y_mc.local, _t(x_mr), precision=precision)
+    mask = _mask_triangle(A, uplo)
+    return A.with_local(jnp.where(mask, _safe_astype(A.local + upd, A.dtype), A.local))
+
+
+def trmv(uplo: str, orient: str, A: DistMatrix, x: DistMatrix,
+         unit: bool = False, precision=None) -> DistMatrix:
+    """x := op(tri(A)) x (``El::Trmv``)."""
+    _check_mcmr(A)
+    n = A.gshape[0]
+    _check_vector(x, n, "x")
+    T = jnp.where(_mask_triangle(A, uplo, strict=unit), A.local, 0)
+    Adm = A.with_local(T)
+    if unit:
+        out = gemv(Adm, x, orient=orient, precision=precision)
+        return out.with_local(out.local + x.local)
+    return gemv(Adm, x, orient=orient, precision=precision)
+
+
+def trsv(uplo: str, orient: str, A: DistMatrix, b: DistMatrix,
+         unit: bool = False, nb: int | None = None,
+         precision=None) -> DistMatrix:
+    """Solve op(tri(A)) x = b (``El::Trsv``) -- the blocked Trsm with one RHS."""
+    _check_vector(b, A.gshape[0], "b")
+    return trsm("L", uplo, orient, A, b, unit=unit, nb=nb, precision=precision)

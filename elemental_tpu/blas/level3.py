@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import math
 
+import numpy as np
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -321,6 +323,241 @@ def _trsm_left(uplo: str, trans: bool, conj: bool, A: DistMatrix, B: DistMatrix,
             # T21 = op(A)[hi-part, s:e] = op(A[s:e, hi-part])
             A1p = redistribute(view(A, rows=(s, e), cols=(lo, hi)), STAR, MC)
             a_loc = A1p.local.T            # [MC,STAR]-storage of A1p^T
+        else:
+            A1p = redistribute(view(A, rows=(lo, hi), cols=(s, e)), MC, STAR)
+            a_loc = A1p.local
+        if conj:
+            a_loc = jnp.conj(a_loc)
+        upd = jnp.matmul(a_loc, X1_mr.local, precision=precision)
+        rest = view(X, rows=(lo, hi))
+        X = update_view(X, rest.with_local(rest.local - upd.astype(X.dtype)),
+                        rows=(lo, hi))
+    return X
+
+
+# ---------------------------------------------------------------------
+# Trr2k / Her2k / Syr2k
+# ---------------------------------------------------------------------
+
+def trr2k(uplo: str, alpha, A_mc: DistMatrix, B_mr: DistMatrix,
+          beta, C_mc: DistMatrix, D_mr: DistMatrix, gamma, E: DistMatrix,
+          precision=None) -> DistMatrix:
+    """Triangular rank-2k: E(tri) := alpha A B + beta C D + gamma E(tri),
+    other triangle untouched (``El::Trr2k`` with [MC,STAR] x [STAR,MR]
+    operand pairs -- the reference's ``LocalTrr2k``)."""
+    for X, d in ((A_mc, (MC, STAR)), (C_mc, (MC, STAR)),
+                 (B_mr, (STAR, MR)), (D_mr, (STAR, MR))):
+        if X.dist != d:
+            raise ValueError(f"trr2k operand expected {d}, got {X.dist}")
+    _check_mcmr(E)
+    mask = _mask_triangle(E, uplo)
+    full = alpha * jnp.matmul(A_mc.local, B_mr.local, precision=precision) \
+        + beta * jnp.matmul(C_mc.local, D_mr.local, precision=precision)
+    return E.with_local(jnp.where(mask, _safe_astype(full + gamma * E.local, E.dtype),
+                                  E.local))
+
+
+def her2k(uplo: str, A: DistMatrix, B: DistMatrix, alpha=1.0, beta=0.0,
+          C: DistMatrix | None = None, orient: str = "N", conj: bool = True,
+          nb: int | None = None, precision=None) -> DistMatrix:
+    """C(tri) := alpha op(A) op(B)^H + conj(alpha) op(B) op(A)^H + beta C(tri)
+    (``El::Her2k``; ``conj=False`` gives ``Syr2k`` with ^T and coefficient
+    alpha on both products).
+
+    Same panel schedule as :func:`herk` (the ``cholesky::LVar3`` chain), two
+    masked storage products per k-panel."""
+    if orient != "N":
+        A = _orient(A, "C" if conj else "T")
+        B = _orient(B, "C" if conj else "T")
+    _check_mcmr(A, B)
+    m, k = A.gshape
+    if B.gshape != (m, k):
+        raise ValueError(f"her2k needs conformal A,B; got {A.gshape} vs {B.gshape}")
+    r, c = A.grid.height, A.grid.width
+    if C is None:
+        dts = [A.dtype, B.dtype]
+        if isinstance(alpha, complex):
+            dts.append(jnp.complex64)
+        C = dm_zeros(m, m, MC, MR, A.grid, dtype=jnp.result_type(*dts))
+        beta = 0.0
+    else:
+        _check_mcmr(C)
+        if C.gshape != (m, m):
+            raise ValueError(f"C shape {C.gshape} != ({m},{m})")
+    kb = _blocksize(nb, c, k)
+    mask = _mask_triangle(C, uplo)
+    alpha2 = jnp.conj(alpha) if conj else alpha
+    acc = beta * C.local if _nonzero(beta) else jnp.zeros_like(C.local)
+    for s in range(0, k, kb):
+        e = min(s + kb, k)
+        A1_vc = redistribute(view(A, cols=(s, e)), VC, STAR)
+        B1_vc = redistribute(view(B, cols=(s, e)), VC, STAR)
+        A1_mc = redistribute(A1_vc, MC, STAR)
+        B1_mc = redistribute(B1_vc, MC, STAR)
+        A1H_mr = redistribute(transpose_dist(A1_vc, conj=conj), STAR, MR)
+        B1H_mr = redistribute(transpose_dist(B1_vc, conj=conj), STAR, MR)
+        acc = acc + alpha * jnp.matmul(A1_mc.local, B1H_mr.local, precision=precision) \
+            + alpha2 * jnp.matmul(B1_mc.local, A1H_mr.local, precision=precision)
+    return C.with_local(jnp.where(mask, _safe_astype(acc, C.dtype), C.local))
+
+
+def syr2k(uplo: str, A: DistMatrix, B: DistMatrix, alpha=1.0, beta=0.0,
+          C: DistMatrix | None = None, orient: str = "N",
+          nb: int | None = None, precision=None) -> DistMatrix:
+    return her2k(uplo, A, B, alpha, beta, C, orient=orient, conj=False,
+                 nb=nb, precision=precision)
+
+
+# ---------------------------------------------------------------------
+# Symm / Hemm / Trmm
+# ---------------------------------------------------------------------
+
+def hemm(side: str, uplo: str, A: DistMatrix, B: DistMatrix, alpha=1.0,
+         beta=0.0, C: DistMatrix | None = None, conj: bool = True,
+         nb: int | None = None, precision=None) -> DistMatrix:
+    """C := alpha A B + beta C (side 'L') or alpha B A + beta C ('R') with
+    Hermitian A stored in the ``uplo`` triangle (``El::Hemm``;
+    ``conj=False`` = ``Symm``).
+
+    TPU-first: materialize the full Hermitian operand once (one
+    transpose-exchange redistribution, ``MakeSymmetric``) and run plain
+    SUMMA -- the MXU prefers one large dense product over the reference's
+    two half-panel accumulations; the one-triangle ACCESS guarantee is kept
+    (make_symmetric reads only the stored triangle)."""
+    from .level1 import make_symmetric
+    _check_mcmr(A, B)
+    full = make_symmetric(A, uplo, conj=conj)
+    if side.upper().startswith("L"):
+        return gemm(full, B, alpha=alpha, beta=beta, C=C, nb=nb, precision=precision)
+    return gemm(B, full, alpha=alpha, beta=beta, C=C, nb=nb, precision=precision)
+
+
+def symm(side: str, uplo: str, A: DistMatrix, B: DistMatrix, alpha=1.0,
+         beta=0.0, C: DistMatrix | None = None, nb: int | None = None,
+         precision=None) -> DistMatrix:
+    return hemm(side, uplo, A, B, alpha, beta, C, conj=False, nb=nb,
+                precision=precision)
+
+
+def trmm(side: str, uplo: str, orient: str, A: DistMatrix, B: DistMatrix,
+         alpha=1.0, unit: bool = False, nb: int | None = None,
+         precision=None) -> DistMatrix:
+    """B := alpha op(tri(A)) B ('L') or alpha B op(tri(A)) ('R')
+    (``El::Trmm``).  The triangle (with optional implicit unit diagonal) is
+    masked on storage; the product is plain SUMMA."""
+    from .level1 import _global_indices
+    _check_mcmr(A, B)
+    T = jnp.where(_mask_triangle(A, uplo, strict=unit), A.local, 0)
+    if unit:
+        I, J = _global_indices(A)
+        on = (J[None, :] == I[:, None]) & (I[:, None] < A.gshape[0])
+        T = jnp.where(on, jnp.asarray(1, A.dtype), T)
+    Tm = A.with_local(T)
+    if side.upper().startswith("L"):
+        return gemm(Tm, B, alpha=alpha, orient_a=orient, nb=nb, precision=precision)
+    return gemm(B, Tm, alpha=alpha, orient_b=orient, nb=nb, precision=precision)
+
+
+# ---------------------------------------------------------------------
+# Two-sided transforms (generalized eigenproblem reductions)
+# ---------------------------------------------------------------------
+
+def two_sided_trsm(uplo: str, A: DistMatrix, L: DistMatrix,
+                   nb: int | None = None, precision=None) -> DistMatrix:
+    """Congruence solve: lower -> inv(L) A inv(L)^H, upper -> inv(U)^H A inv(U)
+    (``El::TwoSidedTrsm`` -- reduces A x = lambda B x with B = L L^H /
+    U^H U to a standard Hermitian problem).  A is read from the ``uplo``
+    triangle; the result is returned full (Hermitian)."""
+    from .level1 import make_symmetric
+    full = make_symmetric(A, uplo, conj=True)
+    if uplo.upper().startswith("L"):
+        Y = trsm("L", "L", "N", L, full, nb=nb, precision=precision)
+        return trsm("R", "L", "C", L, Y, nb=nb, precision=precision)
+    Y = trsm("L", "U", "C", L, full, nb=nb, precision=precision)
+    return trsm("R", "U", "N", L, Y, nb=nb, precision=precision)
+
+
+def two_sided_trmm(uplo: str, A: DistMatrix, L: DistMatrix,
+                   nb: int | None = None, precision=None) -> DistMatrix:
+    """Congruence product: lower -> L^H A L, upper -> U A U^H
+    (``El::TwoSidedTrmm`` -- the inverse transform of two_sided_trsm)."""
+    from .level1 import make_symmetric
+    full = make_symmetric(A, uplo, conj=True)
+    if uplo.upper().startswith("L"):
+        Y = trmm("L", "L", "C", L, full, nb=nb, precision=precision)
+        return trmm("R", "L", "N", L, Y, nb=nb, precision=precision)
+    Y = trmm("L", "U", "N", L, full, nb=nb, precision=precision)
+    return trmm("R", "U", "C", L, Y, nb=nb, precision=precision)
+
+
+# ---------------------------------------------------------------------
+# MultiShiftTrsm (the Pseudospectra / TriangEig engine)
+# ---------------------------------------------------------------------
+
+def multishift_trsm(uplo: str, orient: str, A: DistMatrix, shifts,
+                    B: DistMatrix, alpha=1.0, nb: int | None = None,
+                    precision=None) -> DistMatrix:
+    """Solve (op(tri(A)) - shifts[j] I) X[:, j] = alpha B[:, j] for all j at
+    once (``El::MultiShiftTrsm``, ``src/blas_like/level3/MultiShiftTrsm/``).
+
+    Same blocked sweep as :func:`trsm`; the diagonal-block solve becomes a
+    column-batched shifted triangular solve on the [STAR,VR] panel (each
+    storage column's shift selected by the static cyclic column permutation
+    -- pure local, zero extra communication), and the trailing update is
+    shift-free (shifts only touch diagonal blocks)."""
+    trans = orient in ("T", "C")
+    conj = orient == "C"
+    _check_mcmr(A, B)
+    m, n = B.gshape
+    if A.gshape != (m, m):
+        raise ValueError(f"A {A.gshape} incompatible with B {B.gshape}")
+    shifts = jnp.asarray(shifts)
+    if shifts.shape != (n,):
+        raise ValueError(f"shifts must be ({n},), got {shifts.shape}")
+    lower = uplo.upper().startswith("L")
+    g = A.grid
+    r, c = g.height, g.width
+    p = r * c
+    ib = _blocksize(nb, math.lcm(r, c), m)
+    # static [STAR,VR] storage-column -> global-column map (zero align)
+    lc = -(-n // p)
+    q = np.arange(p)[:, None]
+    jl = np.arange(lc)[None, :]
+    perm = (jl * p + q).reshape(-1)
+    sig_stor = jnp.take(shifts, jnp.asarray(np.clip(perm, 0, n - 1)))
+    sig_stor = jnp.where(jnp.asarray(perm) < n, sig_stor, 0)
+    # (op(M) - sigma I) = op(M - sigma' I): diagonal untouched by T, conj by C
+    sig_eff = jnp.conj(sig_stor) if conj else sig_stor
+
+    X = B.with_local(alpha * B.local if _nonzero(alpha - 1) else B.local)
+    starts = list(range(0, m, ib))
+    forward = lower != trans
+    if not forward:
+        starts = starts[::-1]
+    for s in starts:
+        e = min(s + ib, m)
+        A11 = redistribute(view(A, rows=(s, e), cols=(s, e)), STAR, STAR)
+        a11 = jnp.tril(A11.local) if lower else jnp.triu(A11.local)
+        B1 = redistribute(view(X, rows=(s, e)), STAR, VR)
+        d = a11.shape[0]
+        eye = jnp.eye(d, dtype=a11.dtype)
+
+        def _one(sg, b):
+            return lax.linalg.triangular_solve(
+                a11 - sg * eye, b[:, None], left_side=True, lower=lower,
+                transpose_a=trans, conjugate_a=conj)[:, 0]
+
+        x1 = jax.vmap(_one, in_axes=(0, 1), out_axes=1)(
+            sig_eff.astype(a11.dtype), B1.local)
+        X1 = DistMatrix(x1, B1.gshape, STAR, VR, 0, 0, g)
+        X1_mr = redistribute(X1, STAR, MR)
+        X = update_view(X, redistribute(X1_mr, MC, MR), rows=(s, e))
+        lo, hi = (e, m) if forward else (0, s)
+        if lo >= hi:
+            continue
+        if trans:
+            A1p = redistribute(view(A, rows=(s, e), cols=(lo, hi)), STAR, MC)
+            a_loc = A1p.local.T
         else:
             A1p = redistribute(view(A, rows=(lo, hi), cols=(s, e)), MC, STAR)
             a_loc = A1p.local

@@ -1,0 +1,126 @@
+"""Level-2 oracles vs numpy (cf. reference tests/blas_like drivers)."""
+import numpy as np
+import pytest
+
+import elemental_tpu as el
+from elemental_tpu import MC, MR, from_global, to_global
+
+
+def _vec(rng, m, dtype):
+    v = rng.normal(size=(m, 1))
+    if np.issubdtype(np.dtype(dtype), np.complexfloating):
+        v = v + 1j * rng.normal(size=(m, 1))
+    return v.astype(dtype)
+
+
+def _mat(rng, m, n, dtype):
+    A = rng.normal(size=(m, n))
+    if np.issubdtype(np.dtype(dtype), np.complexfloating):
+        A = A + 1j * rng.normal(size=(m, n))
+    return A.astype(dtype)
+
+
+@pytest.mark.parametrize("orient", ["N", "T", "C"])
+def test_gemv(grid24, orient):
+    rng = np.random.default_rng(0)
+    A = _mat(rng, 13, 9, np.complex128)
+    x = _vec(rng, 9 if orient == "N" else 13, np.complex128)
+    y = _vec(rng, 13 if orient == "N" else 9, np.complex128)
+    Ad = from_global(A, MC, MR, grid=grid24)
+    xd = from_global(x, MC, MR, grid=grid24)
+    yd = from_global(y, MC, MR, grid=grid24)
+    opA = {"N": A, "T": A.T, "C": A.conj().T}[orient]
+    out = el.gemv(Ad, xd, alpha=2.0, beta=-1.5, y=yd, orient=orient)
+    np.testing.assert_allclose(np.asarray(to_global(out)),
+                               2.0 * opA @ x - 1.5 * y, rtol=1e-12)
+
+
+def test_gemv_real_any_grid(any_grid):
+    rng = np.random.default_rng(1)
+    A = _mat(rng, 17, 6, np.float64)
+    x = _vec(rng, 6, np.float64)
+    Ad = from_global(A, MC, MR, grid=any_grid)
+    xd = from_global(x, MC, MR, grid=any_grid)
+    np.testing.assert_allclose(np.asarray(to_global(el.gemv(Ad, xd))),
+                               A @ x, rtol=1e-12)
+
+
+@pytest.mark.parametrize("conj", [True, False])
+def test_ger(grid42, conj):
+    rng = np.random.default_rng(2)
+    A = _mat(rng, 11, 7, np.complex128)
+    x = _vec(rng, 11, np.complex128)
+    y = _vec(rng, 7, np.complex128)
+    Ad = from_global(A, MC, MR, grid=grid42)
+    out = el.ger(0.5 + 0.25j, from_global(x, MC, MR, grid=grid42),
+                 from_global(y, MC, MR, grid=grid42), Ad, conj=conj)
+    yrow = y.conj().T if conj else y.T
+    np.testing.assert_allclose(np.asarray(to_global(out)),
+                               A + (0.5 + 0.25j) * x @ yrow, rtol=1e-12)
+
+
+@pytest.mark.parametrize("uplo", ["L", "U"])
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_hemv_reads_one_triangle(grid24, uplo, dtype):
+    rng = np.random.default_rng(3)
+    H = _mat(rng, 12, 12, dtype)
+    H = H + H.conj().T
+    x = _vec(rng, 12, dtype)
+    # poison the unstored triangle: hemv must not read it
+    P = H.copy()
+    mask = np.tril(np.ones((12, 12), bool), -1) if uplo == "U" \
+        else np.triu(np.ones((12, 12), bool), 1)
+    P[mask] = 1e6
+    Ad = from_global(P, MC, MR, grid=grid24)
+    xd = from_global(x, MC, MR, grid=grid24)
+    out = el.hemv(uplo, Ad, xd, alpha=1.5)
+    np.testing.assert_allclose(np.asarray(to_global(out)), 1.5 * H @ x, rtol=1e-11)
+
+
+def test_symv_complex_is_transpose_not_conj(grid24):
+    rng = np.random.default_rng(4)
+    S = _mat(rng, 10, 10, np.complex128)
+    S = S + S.T                       # complex symmetric (not hermitian)
+    x = _vec(rng, 10, np.complex128)
+    Ad = from_global(np.tril(S), MC, MR, grid=grid24)
+    out = el.symv("L", Ad, from_global(x, MC, MR, grid=grid24))
+    np.testing.assert_allclose(np.asarray(to_global(out)), S @ x, rtol=1e-11)
+
+
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_her2(grid24, uplo):
+    rng = np.random.default_rng(5)
+    H = _mat(rng, 9, 9, np.complex128)
+    H = H + H.conj().T
+    x = _vec(rng, 9, np.complex128)
+    y = _vec(rng, 9, np.complex128)
+    a = 0.3 - 0.7j
+    Ad = from_global(H, MC, MR, grid=grid24)
+    out = el.her2(uplo, a, from_global(x, MC, MR, grid=grid24),
+                  from_global(y, MC, MR, grid=grid24), Ad)
+    full = H + a * x @ y.conj().T + np.conj(a) * y @ x.conj().T
+    got = np.asarray(to_global(out))
+    tri = np.tril if uplo == "L" else np.triu
+    anti = np.triu if uplo == "L" else np.tril
+    np.testing.assert_allclose(tri(got), tri(full), rtol=1e-12)
+    np.testing.assert_allclose(anti(got, 1 if uplo == "L" else -1),
+                               anti(H, 1 if uplo == "L" else -1), rtol=1e-12)
+
+
+@pytest.mark.parametrize("uplo,orient,unit", [("L", "N", False), ("U", "N", True),
+                                              ("U", "C", False), ("L", "T", True)])
+def test_trmv_trsv_roundtrip(grid24, uplo, orient, unit):
+    rng = np.random.default_rng(6)
+    T = _mat(rng, 8, 8, np.complex128)
+    T = (np.tril(T) if uplo == "L" else np.triu(T)) + 3 * np.eye(8)
+    x = _vec(rng, 8, np.complex128)
+    Td = from_global(T, MC, MR, grid=grid24)
+    xd = from_global(x, MC, MR, grid=grid24)
+    Tm = T.copy()
+    if unit:
+        np.fill_diagonal(Tm, 1.0)
+    op = {"N": Tm, "T": Tm.T, "C": Tm.conj().T}[orient]
+    y = el.trmv(uplo, orient, Td, xd, unit=unit)
+    np.testing.assert_allclose(np.asarray(to_global(y)), op @ x, rtol=1e-11)
+    back = el.trsv(uplo, orient, Td, y, unit=unit, nb=4)
+    np.testing.assert_allclose(np.asarray(to_global(back)), x, rtol=1e-9)

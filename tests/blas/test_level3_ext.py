@@ -1,0 +1,181 @@
+"""Oracles for the level-3 completeness pass: Her2k/Syr2k/Trr2k, Hemm/Symm,
+Trmm, TwoSidedTrsm/Trmm, MultiShiftTrsm (cf. reference tests/blas_like)."""
+import numpy as np
+import pytest
+
+import elemental_tpu as el
+from elemental_tpu import MC, MR, STAR, from_global, to_global, redistribute
+
+
+def _mat(rng, m, n, dtype):
+    A = rng.normal(size=(m, n))
+    if np.issubdtype(np.dtype(dtype), np.complexfloating):
+        A = A + 1j * rng.normal(size=(m, n))
+    return A.astype(dtype)
+
+
+def _tri(x, uplo, k=0):
+    return np.tril(x, k) if uplo == "L" else np.triu(x, -k)
+
+
+@pytest.mark.parametrize("uplo", ["L", "U"])
+@pytest.mark.parametrize("orient", ["N", "C"])
+def test_her2k(grid24, uplo, orient):
+    rng = np.random.default_rng(0)
+    A = _mat(rng, 10, 6, np.complex128) if orient == "N" else _mat(rng, 6, 10, np.complex128)
+    B = A * 0 + _mat(rng, *A.shape, np.complex128)
+    C0 = _mat(rng, 10, 10, np.complex128)
+    a = 0.7 - 0.2j
+    Ad = from_global(A, MC, MR, grid=grid24)
+    Bd = from_global(B, MC, MR, grid=grid24)
+    Cd = from_global(C0, MC, MR, grid=grid24)
+    out = el.her2k(uplo, Ad, Bd, alpha=a, beta=0.5, C=Cd, orient=orient, nb=4)
+    opA = A if orient == "N" else A.conj().T
+    opB = B if orient == "N" else B.conj().T
+    full = a * opA @ opB.conj().T + np.conj(a) * opB @ opA.conj().T + 0.5 * C0
+    got = np.asarray(to_global(out))
+    np.testing.assert_allclose(_tri(got, uplo), _tri(full, uplo), rtol=1e-11)
+    untouched = (lambda x: np.triu(x, 1)) if uplo == "L" else (lambda x: np.tril(x, -1))
+    np.testing.assert_allclose(untouched(got), untouched(C0), rtol=0)
+
+
+def test_syr2k(grid42):
+    rng = np.random.default_rng(1)
+    A = _mat(rng, 9, 5, np.complex128)
+    B = _mat(rng, 9, 5, np.complex128)
+    out = el.syr2k("U", from_global(A, MC, MR, grid=grid42),
+                   from_global(B, MC, MR, grid=grid42), alpha=1.5, nb=4)
+    full = 1.5 * (A @ B.T + B @ A.T)
+    np.testing.assert_allclose(np.triu(np.asarray(to_global(out))),
+                               np.triu(full), rtol=1e-11)
+
+
+def test_trr2k(grid24):
+    rng = np.random.default_rng(2)
+    A = _mat(rng, 8, 5, np.float64)
+    B = _mat(rng, 5, 8, np.float64)
+    C = _mat(rng, 8, 5, np.float64)
+    D = _mat(rng, 5, 8, np.float64)
+    E0 = _mat(rng, 8, 8, np.float64)
+    Amc = redistribute(from_global(A, MC, MR, grid=grid24), MC, STAR)
+    Bmr = redistribute(from_global(B, MC, MR, grid=grid24), STAR, MR)
+    Cmc = redistribute(from_global(C, MC, MR, grid=grid24), MC, STAR)
+    Dmr = redistribute(from_global(D, MC, MR, grid=grid24), STAR, MR)
+    Ed = from_global(E0, MC, MR, grid=grid24)
+    out = el.trr2k("L", 2.0, Amc, Bmr, -1.0, Cmc, Dmr, 0.5, Ed)
+    full = 2.0 * A @ B - C @ D + 0.5 * E0
+    got = np.asarray(to_global(out))
+    np.testing.assert_allclose(np.tril(got), np.tril(full), rtol=1e-12)
+    np.testing.assert_allclose(np.triu(got, 1), np.triu(E0, 1), rtol=0)
+
+
+@pytest.mark.parametrize("side", ["L", "R"])
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_hemm(grid24, side, uplo):
+    rng = np.random.default_rng(3)
+    H = _mat(rng, 8, 8, np.complex128)
+    H = H + H.conj().T
+    B = _mat(rng, 8, 6, np.complex128) if side == "L" else _mat(rng, 6, 8, np.complex128)
+    P = H.copy()    # poison unstored triangle
+    mask = np.tril(np.ones((8, 8), bool), -1) if uplo == "U" \
+        else np.triu(np.ones((8, 8), bool), 1)
+    P[mask] = 99.0
+    out = el.hemm(side, uplo, from_global(P, MC, MR, grid=grid24),
+                  from_global(B, MC, MR, grid=grid24), alpha=1.25)
+    want = 1.25 * (H @ B if side == "L" else B @ H)
+    np.testing.assert_allclose(np.asarray(to_global(out)), want, rtol=1e-11)
+
+
+def test_symm_complex_symmetric(grid24):
+    rng = np.random.default_rng(4)
+    S = _mat(rng, 7, 7, np.complex128)
+    S = S + S.T
+    B = _mat(rng, 7, 4, np.complex128)
+    out = el.symm("L", "U", from_global(np.triu(S), MC, MR, grid=grid24),
+                  from_global(B, MC, MR, grid=grid24))
+    np.testing.assert_allclose(np.asarray(to_global(out)), S @ B, rtol=1e-11)
+
+
+@pytest.mark.parametrize("side,uplo,orient,unit",
+                         [("L", "L", "N", False), ("L", "U", "C", False),
+                          ("R", "U", "N", True), ("R", "L", "T", True)])
+def test_trmm(grid24, side, uplo, orient, unit):
+    rng = np.random.default_rng(5)
+    T = _mat(rng, 8, 8, np.complex128)
+    B = _mat(rng, 8, 8, np.complex128)
+    Tm = _tri(T, uplo)
+    if unit:
+        np.fill_diagonal(Tm, 1.0)
+    op = {"N": Tm, "T": Tm.T, "C": Tm.conj().T}[orient]
+    want = 2.0 * (op @ B if side == "L" else B @ op)
+    out = el.trmm(side, uplo, orient, from_global(T, MC, MR, grid=grid24),
+                  from_global(B, MC, MR, grid=grid24), alpha=2.0, unit=unit, nb=4)
+    np.testing.assert_allclose(np.asarray(to_global(out)), want, rtol=1e-11)
+
+
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_two_sided_trsm_generalized_eig(grid24, uplo):
+    """Reduce A x = lambda B x to standard form and check the eigenvalues
+    match scipy's generalized solve (the reference's TwoSidedTrsm test)."""
+    rng = np.random.default_rng(6)
+    n = 8
+    G = rng.normal(size=(n, n))
+    A = G + G.T
+    Fb = rng.normal(size=(n, n))
+    B = Fb @ Fb.T / n + n * np.eye(n)
+    Ad = from_global(A, MC, MR, grid=grid24)
+    Bd = from_global(B, MC, MR, grid=grid24)
+    F = el.cholesky(Bd, uplo, nb=4)
+    S = el.two_sided_trsm(uplo, Ad, F, nb=4)
+    got = np.sort(np.linalg.eigvalsh(np.asarray(to_global(S))))
+    import scipy.linalg
+    want = np.sort(scipy.linalg.eigh(A, B, eigvals_only=True))
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-9)
+
+
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_two_sided_trmm_oracle(grid24, uplo):
+    """lower: L^H A L; upper: U A U^H (the reference's TwoSidedTrmm)."""
+    rng = np.random.default_rng(7)
+    n = 8
+    G = rng.normal(size=(n, n)) + 1j * rng.normal(size=(n, n))
+    A = G + G.conj().T
+    T = rng.normal(size=(n, n)) + 1j * rng.normal(size=(n, n))
+    T = (np.tril(T) if uplo == "L" else np.triu(T)) + 2 * np.eye(n)
+    Ad = from_global(A, MC, MR, grid=grid24)
+    Td = from_global(T, MC, MR, grid=grid24)
+    got = np.asarray(to_global(el.two_sided_trmm(uplo, Ad, Td, nb=4)))
+    want = T.conj().T @ A @ T if uplo == "L" else T @ A @ T.conj().T
+    np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-10)
+
+
+@pytest.mark.parametrize("uplo,orient", [("L", "N"), ("U", "N"), ("U", "C"),
+                                         ("L", "T")])
+def test_multishift_trsm(grid24, uplo, orient):
+    rng = np.random.default_rng(8)
+    m, nrhs = 12, 7
+    T = _mat(rng, m, m, np.complex128)
+    T = _tri(T, uplo) + 4 * np.eye(m)
+    B = _mat(rng, m, nrhs, np.complex128)
+    shifts = (rng.normal(size=nrhs) + 1j * rng.normal(size=nrhs)) * 0.5
+    out = el.multishift_trsm(uplo, orient, from_global(T, MC, MR, grid=grid24),
+                             shifts, from_global(B, MC, MR, grid=grid24),
+                             alpha=1.0, nb=4)
+    X = np.asarray(to_global(out))
+    op = {"N": T, "T": T.T, "C": T.conj().T}[orient]
+    for j in range(nrhs):
+        np.testing.assert_allclose((op - shifts[j] * np.eye(m)) @ X[:, j],
+                                   B[:, j], rtol=1e-10, atol=1e-10)
+
+
+def test_multishift_trsm_matches_trsm_at_zero_shift(any_grid):
+    rng = np.random.default_rng(9)
+    m, nrhs = 8, 4
+    T = np.tril(rng.normal(size=(m, m))) + 3 * np.eye(m)
+    B = rng.normal(size=(m, nrhs))
+    Td = from_global(T, MC, MR, grid=any_grid)
+    Bd = from_global(B, MC, MR, grid=any_grid)
+    ms = el.multishift_trsm("L", "N", Td, np.zeros(nrhs), Bd, nb=4)
+    ts = el.trsm("L", "L", "N", Td, Bd, nb=4)
+    np.testing.assert_allclose(np.asarray(to_global(ms)),
+                               np.asarray(to_global(ts)), rtol=1e-12)
