@@ -9,7 +9,7 @@ from .core.grid import Grid, default_grid, set_default_grid
 from .core.distmatrix import DistMatrix, from_global, to_global, zeros
 from .redist.engine import redistribute, transpose_dist
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from . import blas, lapack, matrices
 from .blas import (gemm, herk, syrk, trrk, trsm, trr2k, her2k, syr2k,
