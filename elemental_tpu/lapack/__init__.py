@@ -3,3 +3,5 @@ SURVEY.md §3.4 / §8.2)."""
 from .cholesky import cholesky, hpd_solve, cholesky_solve_after
 from .lu import lu, lu_solve, lu_solve_after, permute_rows
 from .qr import qr, apply_q, explicit_q, least_squares, tsqr
+from .condense import (hermitian_tridiag, apply_q_herm_tridiag, hessenberg,
+                       apply_q_hessenberg)

@@ -1,0 +1,78 @@
+"""HermitianTridiag / Hessenberg oracles.
+
+Model: reference ``tests/lapack_like/HermitianTridiag.cpp`` -- residual
+``||A - Q T Q^H||/||A||`` + orthogonality ``||I - Q^H Q||``, real & complex.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elemental_tpu import from_global, to_global, MC, MR
+from elemental_tpu.lapack.condense import (
+    hermitian_tridiag, apply_q_herm_tridiag, hessenberg, apply_q_hessenberg)
+from elemental_tpu.matrices.basic import identity
+
+
+def _herm(n, dtype, seed=0):
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((n, n))
+    if jnp.issubdtype(dtype, jnp.complexfloating):
+        A = A + 1j * rng.standard_normal((n, n))
+    A = (A + A.conj().T) / 2
+    return A.astype(dtype)
+
+
+def _tridiag_full(d, e):
+    return np.diag(np.asarray(d)) + np.diag(np.asarray(e), -1) + np.diag(np.asarray(e), 1)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.complex128])
+@pytest.mark.parametrize("n", [24, 37])
+def test_hermitian_tridiag(grid24, dtype, n):
+    A = _herm(n, dtype)
+    Ad = from_global(A, MC, MR, grid24)
+    Ap, d, e, tau = hermitian_tridiag(Ad, nb=8)
+    T = _tridiag_full(d, e)
+    # Q explicit via back-transform of the identity
+    Q = apply_q_herm_tridiag(Ap, tau, identity(n, grid=grid24, dtype=dtype), nb=8)
+    Qg = np.asarray(to_global(Q))
+    resid = np.linalg.norm(A - Qg @ T @ Qg.conj().T) / max(np.linalg.norm(A), 1)
+    orth = np.linalg.norm(np.eye(n) - Qg.conj().T @ Qg)
+    assert resid < 1e-12
+    assert orth < 1e-12
+    # eigenvalues preserved
+    np.testing.assert_allclose(np.linalg.eigvalsh(T), np.linalg.eigvalsh(A),
+                               rtol=1e-10, atol=1e-10)
+
+
+def test_hermitian_tridiag_uplo_upper(grid24):
+    n = 24
+    A = _herm(n, jnp.float64, seed=3)
+    # poison the lower strict triangle: 'U' must only read the upper
+    Abad = A.copy()
+    Abad[np.tril_indices(n, -1)] = 99.0
+    Ad = from_global(Abad, MC, MR, grid24)
+    Ap, d, e, tau = hermitian_tridiag(Ad, uplo="U", nb=8)
+    T = _tridiag_full(d, e)
+    np.testing.assert_allclose(np.linalg.eigvalsh(T), np.linalg.eigvalsh(A),
+                               rtol=1e-10, atol=1e-10)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.complex128])
+def test_hessenberg(grid24, dtype):
+    n = 21
+    rng = np.random.default_rng(7)
+    A = rng.standard_normal((n, n))
+    if jnp.issubdtype(dtype, jnp.complexfloating):
+        A = A + 1j * rng.standard_normal((n, n))
+    A = A.astype(dtype)
+    Ad = from_global(A, MC, MR, grid24)
+    H, Qp, tau = hessenberg(Ad)
+    Hg = np.asarray(to_global(H))
+    assert np.abs(np.tril(Hg, -2)).max() < 1e-12
+    Q = apply_q_hessenberg(Qp, tau, identity(n, grid=grid24, dtype=dtype))
+    Qg = np.asarray(to_global(Q))
+    resid = np.linalg.norm(A - Qg @ Hg @ Qg.conj().T) / np.linalg.norm(A)
+    orth = np.linalg.norm(np.eye(n) - Qg.conj().T @ Qg)
+    assert resid < 1e-12
+    assert orth < 1e-12
