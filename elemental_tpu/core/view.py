@@ -82,3 +82,47 @@ def split_point(n: int, grain: int) -> int:
     if half == 0 or half >= n:
         half = grain
     return min(half, n)
+
+
+def pad_matrix(A: DistMatrix, M: int, N: int) -> DistMatrix:
+    """Extend the global shape to (M, N) >= gshape with explicit zeros.
+
+    Pure-local storage reshape (the cyclic layout keeps each device's block
+    contiguous per residue class) -- the ragged-edge tool for algorithms that
+    need grain-aligned extents (SURVEY.md §8.3 item 5).
+    """
+    m, n = A.gshape
+    if M < m or N < n:
+        raise ValueError(f"pad_matrix target ({M},{N}) smaller than {A.gshape}")
+    Sc, Sr = A.col_stride, A.row_stride
+    lr2 = ix.max_local_length(M, Sc)
+    lc2 = ix.max_local_length(N, Sr)
+    b, lr, lc = _blocked(A.local, Sc, Sr)
+    b = jnp.pad(b, ((0, 0), (0, lr2 - lr), (0, 0), (0, lc2 - lc)))
+    out = dataclasses.replace(A, local=b.reshape(Sc * lr2, Sr * lc2),
+                              gshape=(M, N))
+    return out
+
+
+def shrink_matrix(A: DistMatrix, m: int, n: int) -> DistMatrix:
+    """Restrict the global shape to (m, n) <= gshape, re-zeroing the newly
+    out-of-range entries (keeps the padding-is-zero invariant)."""
+    M, N = A.gshape
+    if m > M or n > N:
+        raise ValueError(f"shrink_matrix target ({m},{n}) larger than {A.gshape}")
+    Sc, Sr = A.col_stride, A.row_stride
+    lr2 = ix.max_local_length(m, Sc)
+    lc2 = ix.max_local_length(n, Sr)
+    b, lr, lc = _blocked(A.local, Sc, Sr)
+    b = b[:, :lr2, :, :lc2]
+    out = dataclasses.replace(A, local=b.reshape(Sc * lr2, Sr * lc2),
+                              gshape=(m, n))
+    # zero entries whose global index is now out of range
+    q = jnp.arange(Sc)[:, None]
+    il = jnp.arange(lr2)[None, :]
+    I = (il * Sc + (q - A.calign) % Sc).reshape(-1)
+    q2 = jnp.arange(Sr)[:, None]
+    jl = jnp.arange(lc2)[None, :]
+    J = (jl * Sr + (q2 - A.ralign) % Sr).reshape(-1)
+    keep = (I[:, None] < m) & (J[None, :] < n)
+    return out.with_local(jnp.where(keep, out.local, 0))

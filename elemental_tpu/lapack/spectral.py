@@ -1,0 +1,246 @@
+"""Spectral layer: Hermitian eigensolvers and the SVD.
+
+Reference: Elemental ``src/lapack_like/spectral/HermitianEig.cpp``
+(``El::HermitianEig``: tridiagonalize -> tridiagonal EVP -> back-transform;
+upstream solves the tridiagonal problem with bundled PMRRR), ``SVD.cpp``
+(``El::SVD``, ``svd::Chan`` tall path), ``HermitianGenDefEig``,
+``SkewHermitianEig``, ``HermitianSVD``.
+
+TPU-native redesign (SURVEY.md §8.1 item 4): PMRRR (MPI+pthreads C) has no
+TPU analog, so the tridiagonal EVP is solved REDUNDANTLY on every device on
+the replicated (d, e) -- the same shape as the reference's older
+gather-and-run-LAPACK-redundantly path for bidiagonal SVD -- while all
+O(n^3) work (the reduction and the eigenvector back-transform) stays
+distributed and matmul-shaped.  The matmul-rich polar-based spectral D&C
+(QDWH-eig, PAPERS.md arXiv 2112.09017) lives in :mod:`.funcs` /
+:func:`herm_eig` ``approach='qdwh'``.
+
+Subset eigenpairs (``HermitianEigSubset``) select tridiagonal eigenvector
+columns BEFORE the back-transform, so a k-subset costs an (n, k) apply-Q.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dist import MC, MR, STAR
+from ..core.distmatrix import DistMatrix
+from ..core.view import view, round_up
+from ..redist.engine import redistribute, transpose_dist
+from ..blas.level3 import _check_mcmr, gemm, trsm, two_sided_trsm
+from .cholesky import cholesky
+from .condense import hermitian_tridiag, apply_q_herm_tridiag, _real_dtype
+from .qr import qr, apply_q
+
+
+def _sym_from_triangle(Ag, uplo: str):
+    """Rebuild the full Hermitian matrix from one stored triangle."""
+    if uplo.upper().startswith("L"):
+        t = jnp.tril(Ag)
+        return t + jnp.conj(jnp.tril(t, -1)).T
+    t = jnp.triu(Ag)
+    return t + jnp.conj(jnp.triu(t, 1)).T
+
+
+def _subset_slice(w, subset):
+    """Resolve a HermitianEigSubset analog to a column slice (host-side).
+
+    ``subset``: None (all), ``('index', il, iu)`` inclusive indices into the
+    ascending spectrum, or ``('value', lo, hi)`` half-open value window.
+    """
+    n = w.shape[0]
+    if subset is None:
+        return 0, n
+    kind = subset[0]
+    if kind == "index":
+        il, iu = subset[1], subset[2]
+        return il, iu + 1
+    if kind == "value":
+        lo, hi = subset[1], subset[2]
+        wn = np.asarray(w)
+        il = int(np.searchsorted(wn, lo, side="left"))
+        iu = int(np.searchsorted(wn, hi, side="left"))
+        return il, iu
+    raise ValueError(f"bad subset {subset!r}")
+
+
+def herm_eig(A: DistMatrix, uplo: str = "L", vectors: bool = True,
+             subset=None, nb: int | None = None, approach: str = "tridiag",
+             precision=None):
+    """Eigendecomposition of a Hermitian [MC,MR] matrix: ``A = Z diag(w) Z^H``
+    (``El::HermitianEig``).  Returns ascending real ``w`` (replicated) and,
+    when ``vectors``, the distributed eigenvector matrix ``Z``.
+    """
+    _check_mcmr(A)
+    n = A.gshape[0]
+    if A.gshape != (n, n):
+        raise ValueError(f"herm_eig needs square, got {A.gshape}")
+    g = A.grid
+    rdtype = _real_dtype(A.dtype)
+    if n <= 2:
+        Ag = _sym_from_triangle(redistribute(A, STAR, STAR).local, uplo)
+        w, Z = jnp.linalg.eigh(Ag)
+        s, e = _subset_slice(w, subset)
+        w = w[s:e].astype(rdtype)
+        if not vectors:
+            return w
+        Zd = redistribute(
+            DistMatrix(Z[:, s:e], (n, e - s), STAR, STAR, 0, 0, g), MC, MR)
+        return w, Zd
+    if approach == "qdwh":
+        from .funcs import _qdwh_eig
+        return _qdwh_eig(A, uplo, vectors, subset, nb, precision)
+    Ap, d, e_, tau = hermitian_tridiag(A, uplo, nb=nb, precision=precision)
+    T = (jnp.diag(d) + jnp.diag(e_, -1) + jnp.diag(e_, 1)).astype(rdtype)
+    w, ZT = jnp.linalg.eigh(T)            # redundant replicated tridiag solve
+    s, e = _subset_slice(w, subset)
+    w = w[s:e]
+    if not vectors:
+        return w
+    k = e - s
+    ZTd = redistribute(
+        DistMatrix(ZT[:, s:e].astype(A.dtype), (n, k), STAR, STAR, 0, 0, g),
+        MC, MR)
+    Z = apply_q_herm_tridiag(Ap, tau, ZTd, orient="N", nb=nb,
+                             precision=precision)
+    return w, Z
+
+
+def skew_herm_eig(A: DistMatrix, uplo: str = "L", vectors: bool = True,
+                  subset=None, nb: int | None = None, precision=None):
+    """Eigenvalues (purely imaginary, returned as their imaginary parts,
+    ascending) of a skew-Hermitian matrix: eig(iA) with a sign flip
+    (``El::SkewHermitianEig``)."""
+    cdtype = jnp.result_type(A.dtype, jnp.complex64)
+    iA = A.with_local((1j * A.local.astype(cdtype)))
+    out = herm_eig(iA, uplo, vectors, subset, nb, precision=precision)
+    # eig(A) = -i * eig(iA): imaginary parts are -w; re-sort ascending.
+    if not vectors:
+        return -out[::-1]
+    w, Z = out
+    n = Z.gshape[0]
+    k = Z.gshape[1]
+    Zs = redistribute(Z, STAR, STAR).local[:, ::-1]
+    Zr = redistribute(DistMatrix(Zs, (n, k), STAR, STAR, 0, 0, Z.grid), MC, MR)
+    return (-w)[::-1], Zr
+
+
+def herm_gen_def_eig(A: DistMatrix, B: DistMatrix, uplo: str = "L",
+                     vectors: bool = True, subset=None, nb: int | None = None,
+                     precision=None):
+    """Generalized definite pencil ``A x = w B x`` with HPD ``B``
+    (``El::HermitianGenDefEig``, AXBX form): Cholesky B = L L^H, reduce via
+    ``TwoSidedTrsm`` to ``L^-1 A L^-H``, solve, back-substitute
+    ``x = L^-H y``."""
+    L = cholesky(B, "L", nb=nb, precision=precision)
+    C = two_sided_trsm(uplo, A, L, nb=nb, precision=precision)
+    out = herm_eig(C, uplo, vectors, subset, nb=nb, precision=precision)
+    if not vectors:
+        return out
+    w, Y = out
+    X = trsm("L", "L", "C", L, Y, nb=nb, precision=precision)
+    return w, X
+
+
+# ---------------------------------------------------------------------
+# SVD
+# ---------------------------------------------------------------------
+
+def hermitian_svd(A: DistMatrix, uplo: str = "L", vectors: bool = True,
+                  nb: int | None = None, precision=None):
+    """SVD of a Hermitian matrix via its eigendecomposition
+    (``El::HermitianSVD``): s = |w| descending, U = Z*sign(w), V = Z."""
+    out = herm_eig(A, uplo, vectors, nb=nb, precision=precision)
+    if not vectors:
+        w = out
+        return jnp.sort(jnp.abs(w))[::-1]
+    w, Z = out
+    order = jnp.argsort(-jnp.abs(w))
+    s = jnp.abs(w)[order]
+    signs = jnp.where(w[order] < 0, -1.0, 1.0).astype(A.dtype)
+    # column permutation + sign scaling on the storage form: columns of the
+    # storage array are a cyclic permutation of global columns; do it on the
+    # replicated factor instead (n x n already replicated in the tridiag
+    # solve would be cheaper -- v1 keeps the API simple)
+    Zs = redistribute(Z, STAR, STAR).local[:, order]
+    n = A.gshape[0]
+    V = redistribute(DistMatrix(Zs, (n, n), STAR, STAR, 0, 0, A.grid), MC, MR)
+    U = redistribute(DistMatrix(Zs * signs[None, :], (n, n), STAR, STAR, 0, 0,
+                                A.grid), MC, MR)
+    return U, s, V
+
+
+def svd(A: DistMatrix, vectors: bool = True, approach: str = "auto",
+        nb: int | None = None, precision=None):
+    """Singular value decomposition ``A = U diag(s) V^H`` (``El::SVD``).
+
+    ``approach``:
+      * 'chan'  -- tall path (``svd::Chan``): QR first, SVD of the small R,
+        U = Q U_R (the reference's default for m >= 1.5 n).
+      * 'polar' -- QDWH polar + Hermitian eigensolve of the factor H
+        (matmul-rich, fully distributed; the TPU-paper recipe).
+      * 'auto'  -- 'chan' when m >= 1.5 n (or the mirrored transpose when
+        n >= 1.5 m), else 'polar'.
+    Returns (U, s, V) with s descending (replicated real vector).
+    """
+    _check_mcmr(A)
+    m, n = A.gshape
+    g = A.grid
+    if n > m:
+        out = svd(redistribute(transpose_dist(A, conj=True), MC, MR),
+                  vectors, approach, nb, precision)
+        if not vectors:
+            return out
+        U, s, V = out
+        return V, s, U
+    if approach == "auto":
+        approach = "chan" if m >= max(int(1.5 * n), n + 1) else "polar"
+
+    if approach == "chan" and m > n:
+        Ap, tau = qr(A, nb=nb, precision=precision)
+        n_up = min(round_up(n, math.lcm(g.height, g.width)), m)
+        R_rep = redistribute(view(Ap, rows=(0, n_up), cols=(0, n)), STAR, STAR)
+        R = jnp.triu(R_rep.local[:n, :])
+        Rd = redistribute(DistMatrix(R, (n, n), STAR, STAR, 0, 0, g), MC, MR)
+        out = svd(Rd, vectors, "polar" if n > 128 else "local", nb, precision)
+        if not vectors:
+            return out
+        UR, s, V = out
+        # U = Q [UR; 0]
+        URs = redistribute(UR, STAR, STAR).local
+        pad = jnp.zeros((m - n, n), A.dtype)
+        U0 = redistribute(DistMatrix(jnp.concatenate([URs, pad]), (m, n),
+                                     STAR, STAR, 0, 0, g), MC, MR)
+        U = apply_q(Ap, tau, U0, orient="N", nb=nb, precision=precision)
+        return U, s, V
+
+    if approach == "local" or (approach in ("chan",) and m == n):
+        approach = "local"
+    if approach == "local":
+        # replicated fallback for small blocks (the redundant-LAPACK analog)
+        Ag = redistribute(A, STAR, STAR).local
+        U, s, Vh = jnp.linalg.svd(Ag, full_matrices=False)
+        if not vectors:
+            return s.astype(_real_dtype(A.dtype))
+        Ud = redistribute(DistMatrix(U, (m, n), STAR, STAR, 0, 0, g), MC, MR)
+        Vd = redistribute(DistMatrix(jnp.conj(Vh).T, (n, n), STAR, STAR, 0, 0, g),
+                          MC, MR)
+        return Ud, s.astype(_real_dtype(A.dtype)), Vd
+
+    # polar path: A = Up H; H = V diag(w) V^H; s = w desc; U = Up V
+    from .funcs import polar
+    Up, H = polar(A, nb=nb, precision=precision)
+    if not vectors:
+        w = herm_eig(H, "L", vectors=False, nb=nb, precision=precision)
+        return jnp.clip(jnp.sort(w)[::-1], 0, None)
+    w, V = herm_eig(H, "L", True, nb=nb, precision=precision)
+    # H is PSD: w ascending >= 0 (up to rounding); descending order
+    order = jnp.argsort(-w)
+    s = jnp.clip(w[order], 0, None)
+    Vs = redistribute(V, STAR, STAR).local[:, order]
+    n_ = A.gshape[1]
+    Vd = redistribute(DistMatrix(Vs, (n_, n_), STAR, STAR, 0, 0, g), MC, MR)
+    U = gemm(Up, Vd, precision=precision)
+    return U, s, Vd
