@@ -17,5 +17,11 @@ from .blas import (gemm, herk, syrk, trrk, trsm, trr2k, her2k, syr2k,
                    multishift_trsm)
 from .blas import gemv, ger, hemv, symv, her2, trmv, trsv
 from .lapack import cholesky, hpd_solve, cholesky_solve_after
-from .lapack import lu, lu_solve, lu_solve_after, permute_rows
+from .lapack import lu, lu_solve, lu_solve_after, permute_rows, permute_cols
 from .lapack import qr, apply_q, explicit_q, least_squares, tsqr
+from .lapack import (hermitian_tridiag, apply_q_herm_tridiag, hessenberg,
+                     apply_q_hessenberg)
+from .lapack import (polar, sign, inverse, triangular_inverse, hpd_inverse,
+                     pseudoinverse, square_root, hpd_square_root)
+from .lapack import herm_eig, skew_herm_eig, herm_gen_def_eig, hermitian_svd, svd
+from .redist.interior import interior_view, interior_update, vstack, hstack

@@ -1,7 +1,11 @@
 """LAPACK-like layer: factorizations, solves, spectral (growing per
 SURVEY.md §3.4 / §8.2)."""
 from .cholesky import cholesky, hpd_solve, cholesky_solve_after
-from .lu import lu, lu_solve, lu_solve_after, permute_rows
+from .lu import lu, lu_solve, lu_solve_after, permute_rows, permute_cols
 from .qr import qr, apply_q, explicit_q, least_squares, tsqr
 from .condense import (hermitian_tridiag, apply_q_herm_tridiag, hessenberg,
                        apply_q_hessenberg)
+from .funcs import (polar, sign, inverse, triangular_inverse, hpd_inverse,
+                    pseudoinverse, square_root, hpd_square_root)
+from .spectral import (herm_eig, skew_herm_eig, herm_gen_def_eig,
+                       hermitian_svd, svd)

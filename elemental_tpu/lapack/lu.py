@@ -24,7 +24,7 @@ import math
 import jax.numpy as jnp
 from jax import lax
 
-from ..core.dist import MC, MR, STAR, VR
+from ..core.dist import MC, MR, STAR, VC, VR
 from ..core.distmatrix import DistMatrix
 from ..core.view import view, update_view
 from ..redist.engine import redistribute
@@ -44,6 +44,18 @@ def permute_rows(B: DistMatrix, perm, inverse: bool = False) -> DistMatrix:
     Bvr = redistribute(B, STAR, VR)
     p = jnp.argsort(perm) if inverse else perm
     out = Bvr.with_local(Bvr.local[p, :])
+    return redistribute(out, MC, MR)
+
+
+def permute_cols(B: DistMatrix, perm, inverse: bool = False) -> DistMatrix:
+    """B[:, perm] as a DistMatrix (``DistPermutation::PermuteCols``).
+
+    Rides [VC,STAR]: columns replicated there, so the traced-index gather is
+    pure-local; two engine hops re-land [MC,MR]."""
+    _check_mcmr(B)
+    Bvc = redistribute(B, VC, STAR)
+    p = jnp.argsort(perm) if inverse else perm
+    out = Bvc.with_local(Bvc.local[:, p])
     return redistribute(out, MC, MR)
 
 

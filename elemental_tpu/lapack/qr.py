@@ -28,7 +28,7 @@ from ..core.dist import MC, MR, VC, STAR
 from ..core.distmatrix import DistMatrix
 from ..core.view import view, update_view
 from ..redist.engine import redistribute
-from ..blas.level3 import _blocksize, _check_mcmr
+from ..blas.level3 import _blocksize, _check_mcmr, trsm
 from .lu import _update_cols_lt, _update_cols_ge
 
 
@@ -190,25 +190,19 @@ def least_squares(A: DistMatrix, B: DistMatrix, nb: int | None = None,
     """Minimize ||A X - B||_F for m >= n via QR (``El::LeastSquares``,
     dense path of ``src/lapack_like/euclidean_min/LeastSquares.cpp``).
 
-    v1 solves the small n x n triangular system on replicated storage
-    (fine for tall systems; a distributed R-solve lands with the general
-    ragged-subview engine)."""
+    Fully distributed: Q^H B via packed reflectors, then a distributed
+    triangular solve against the interior-extracted R (no replication)."""
+    from ..redist.interior import interior_view      # qr <- interior is cycle-free
+    from ..blas.level1 import make_trapezoidal
     _check_mcmr(A, B)
     m, n = A.gshape
     if m < n:
         raise ValueError("least_squares requires m >= n (tall)")
-    g = A.grid
-    r = g.height
     Ap, tau = qr(A, nb=nb, precision=precision)
     Y = apply_q(Ap, tau, B, orient="C", nb=nb, precision=precision)
-    n_up = min(-(-n // r) * r, m)
-    R_rep = redistribute(view(Ap, rows=(0, n_up), cols=(0, n)), STAR, STAR)
-    R = jnp.triu(R_rep.local[:n, :])
-    nrhs = B.gshape[1]
-    Yr = redistribute(view(Y, rows=(0, n_up)), STAR, STAR).local[:n, :]
-    x = lax.linalg.triangular_solve(R, Yr, left_side=True, lower=False)
-    X_ss = DistMatrix(x, (n, nrhs), STAR, STAR, 0, 0, g)
-    return redistribute(X_ss, MC, MR)
+    R = make_trapezoidal(interior_view(Ap, (0, n), (0, n)), "U")
+    Y1 = interior_view(Y, (0, n), (0, B.gshape[1]))
+    return trsm("L", "U", "N", R, Y1, nb=nb, precision=precision)
 
 
 # ---------------------------------------------------------------------

@@ -20,18 +20,19 @@ columns BEFORE the back-transform, so a k-subset costs an (n, k) apply-Q.
 """
 from __future__ import annotations
 
-import math
-
 import jax.numpy as jnp
 import numpy as np
 
 from ..core.dist import MC, MR, STAR
 from ..core.distmatrix import DistMatrix
-from ..core.view import view, round_up
 from ..redist.engine import redistribute, transpose_dist
 from ..blas.level3 import _check_mcmr, gemm, trsm, two_sided_trsm
+from ..core.view import pad_matrix
+from ..redist.interior import interior_view
+from ..blas.level1 import diagonal_scale, make_trapezoidal
 from .cholesky import cholesky
 from .condense import hermitian_tridiag, apply_q_herm_tridiag, _real_dtype
+from .lu import permute_cols
 from .qr import qr, apply_q
 
 
@@ -48,7 +49,10 @@ def _subset_slice(w, subset):
     """Resolve a HermitianEigSubset analog to a column slice (host-side).
 
     ``subset``: None (all), ``('index', il, iu)`` inclusive indices into the
-    ascending spectrum, or ``('value', lo, hi)`` half-open value window.
+    ascending spectrum, or ``('value', lo, hi)`` selecting the half-open
+    interval (lo, hi] -- LAPACK range='V' / ``HermitianEigSubset``
+    semantics.  An optional 4th element overrides the searchsorted sides
+    (internal; used by the skew translation).
     """
     n = w.shape[0]
     if subset is None:
@@ -59,9 +63,10 @@ def _subset_slice(w, subset):
         return il, iu + 1
     if kind == "value":
         lo, hi = subset[1], subset[2]
+        sides = subset[3] if len(subset) > 3 else ("right", "right")
         wn = np.asarray(w)
-        il = int(np.searchsorted(wn, lo, side="left"))
-        iu = int(np.searchsorted(wn, hi, side="left"))
+        il = int(np.searchsorted(wn, lo, side=sides[0]))
+        iu = int(np.searchsorted(wn, hi, side=sides[1]))
         return il, iu
     raise ValueError(f"bad subset {subset!r}")
 
@@ -108,35 +113,53 @@ def herm_eig(A: DistMatrix, uplo: str = "L", vectors: bool = True,
     return w, Z
 
 
+def _translate_skew_subset(subset, n: int):
+    """Map a subset request on the FINAL ascending imaginary parts
+    ``m_j = -w_{n-1-j}`` to one on ``w = eig(iA)`` (ascending)."""
+    if subset is None:
+        return None
+    kind = subset[0]
+    if kind == "index":
+        il, iu = subset[1], subset[2]
+        return ("index", n - 1 - iu, n - 1 - il)
+    if kind == "value":
+        lo, hi = subset[1], subset[2]
+        # m in (lo, hi]  <=>  w = -m in [-hi, -lo)
+        return ("value", -hi, -lo, ("left", "left"))
+    raise ValueError(f"bad subset {subset!r}")
+
+
 def skew_herm_eig(A: DistMatrix, uplo: str = "L", vectors: bool = True,
-                  subset=None, nb: int | None = None, precision=None):
+                  subset=None, nb: int | None = None, precision=None,
+                  approach: str = "tridiag"):
     """Eigenvalues (purely imaginary, returned as their imaginary parts,
     ascending) of a skew-Hermitian matrix: eig(iA) with a sign flip
     (``El::SkewHermitianEig``)."""
     cdtype = jnp.result_type(A.dtype, jnp.complex64)
     iA = A.with_local((1j * A.local.astype(cdtype)))
-    out = herm_eig(iA, uplo, vectors, subset, nb, precision=precision)
+    n = A.gshape[0]
+    out = herm_eig(iA, uplo, vectors, _translate_skew_subset(subset, n), nb,
+                   approach=approach, precision=precision)
     # eig(A) = -i * eig(iA): imaginary parts are -w; re-sort ascending.
     if not vectors:
         return -out[::-1]
     w, Z = out
-    n = Z.gshape[0]
     k = Z.gshape[1]
-    Zs = redistribute(Z, STAR, STAR).local[:, ::-1]
-    Zr = redistribute(DistMatrix(Zs, (n, k), STAR, STAR, 0, 0, Z.grid), MC, MR)
+    Zr = permute_cols(Z, jnp.arange(k)[::-1]) if k > 1 else Z
     return (-w)[::-1], Zr
 
 
 def herm_gen_def_eig(A: DistMatrix, B: DistMatrix, uplo: str = "L",
                      vectors: bool = True, subset=None, nb: int | None = None,
-                     precision=None):
+                     precision=None, approach: str = "tridiag"):
     """Generalized definite pencil ``A x = w B x`` with HPD ``B``
     (``El::HermitianGenDefEig``, AXBX form): Cholesky B = L L^H, reduce via
     ``TwoSidedTrsm`` to ``L^-1 A L^-H``, solve, back-substitute
     ``x = L^-H y``."""
     L = cholesky(B, "L", nb=nb, precision=precision)
     C = two_sided_trsm(uplo, A, L, nb=nb, precision=precision)
-    out = herm_eig(C, uplo, vectors, subset, nb=nb, precision=precision)
+    out = herm_eig(C, uplo, vectors, subset, nb=nb, approach=approach,
+                   precision=precision)
     if not vectors:
         return out
     w, Y = out
@@ -149,10 +172,12 @@ def herm_gen_def_eig(A: DistMatrix, B: DistMatrix, uplo: str = "L",
 # ---------------------------------------------------------------------
 
 def hermitian_svd(A: DistMatrix, uplo: str = "L", vectors: bool = True,
-                  nb: int | None = None, precision=None):
+                  nb: int | None = None, precision=None,
+                  approach: str = "tridiag"):
     """SVD of a Hermitian matrix via its eigendecomposition
     (``El::HermitianSVD``): s = |w| descending, U = Z*sign(w), V = Z."""
-    out = herm_eig(A, uplo, vectors, nb=nb, precision=precision)
+    out = herm_eig(A, uplo, vectors, nb=nb, approach=approach,
+                   precision=precision)
     if not vectors:
         w = out
         return jnp.sort(jnp.abs(w))[::-1]
@@ -160,20 +185,15 @@ def hermitian_svd(A: DistMatrix, uplo: str = "L", vectors: bool = True,
     order = jnp.argsort(-jnp.abs(w))
     s = jnp.abs(w)[order]
     signs = jnp.where(w[order] < 0, -1.0, 1.0).astype(A.dtype)
-    # column permutation + sign scaling on the storage form: columns of the
-    # storage array are a cyclic permutation of global columns; do it on the
-    # replicated factor instead (n x n already replicated in the tridiag
-    # solve would be cheaper -- v1 keeps the API simple)
-    Zs = redistribute(Z, STAR, STAR).local[:, order]
-    n = A.gshape[0]
-    V = redistribute(DistMatrix(Zs, (n, n), STAR, STAR, 0, 0, A.grid), MC, MR)
-    U = redistribute(DistMatrix(Zs * signs[None, :], (n, n), STAR, STAR, 0, 0,
-                                A.grid), MC, MR)
+    V = permute_cols(Z, order)          # distributed column permutation
+    d = DistMatrix(signs[:, None], (signs.shape[0], 1), STAR, STAR, 0, 0,
+                   A.grid)
+    U = diagonal_scale("R", d, V)
     return U, s, V
 
 
 def svd(A: DistMatrix, vectors: bool = True, approach: str = "auto",
-        nb: int | None = None, precision=None):
+        nb: int | None = None, precision=None, eig_approach: str = "tridiag"):
     """Singular value decomposition ``A = U diag(s) V^H`` (``El::SVD``).
 
     ``approach``:
@@ -183,6 +203,8 @@ def svd(A: DistMatrix, vectors: bool = True, approach: str = "auto",
         (matmul-rich, fully distributed; the TPU-paper recipe).
       * 'auto'  -- 'chan' when m >= 1.5 n (or the mirrored transpose when
         n >= 1.5 m), else 'polar'.
+    ``eig_approach`` is forwarded to the inner :func:`herm_eig` ('qdwh'
+    selects the fully-scalable spectral D&C).
     Returns (U, s, V) with s descending (replicated real vector).
     """
     _check_mcmr(A)
@@ -190,7 +212,7 @@ def svd(A: DistMatrix, vectors: bool = True, approach: str = "auto",
     g = A.grid
     if n > m:
         out = svd(redistribute(transpose_dist(A, conj=True), MC, MR),
-                  vectors, approach, nb, precision)
+                  vectors, approach, nb, precision, eig_approach)
         if not vectors:
             return out
         U, s, V = out
@@ -200,19 +222,14 @@ def svd(A: DistMatrix, vectors: bool = True, approach: str = "auto",
 
     if approach == "chan" and m > n:
         Ap, tau = qr(A, nb=nb, precision=precision)
-        n_up = min(round_up(n, math.lcm(g.height, g.width)), m)
-        R_rep = redistribute(view(Ap, rows=(0, n_up), cols=(0, n)), STAR, STAR)
-        R = jnp.triu(R_rep.local[:n, :])
-        Rd = redistribute(DistMatrix(R, (n, n), STAR, STAR, 0, 0, g), MC, MR)
-        out = svd(Rd, vectors, "polar" if n > 128 else "local", nb, precision)
+        Rd = make_trapezoidal(interior_view(Ap, (0, n), (0, n)), "U")
+        out = svd(Rd, vectors, "polar" if n > 128 else "local", nb, precision,
+                  eig_approach)
         if not vectors:
             return out
         UR, s, V = out
-        # U = Q [UR; 0]
-        URs = redistribute(UR, STAR, STAR).local
-        pad = jnp.zeros((m - n, n), A.dtype)
-        U0 = redistribute(DistMatrix(jnp.concatenate([URs, pad]), (m, n),
-                                     STAR, STAR, 0, 0, g), MC, MR)
+        # U = Q [UR; 0] -- the row pad is a pure-local storage extension
+        U0 = pad_matrix(UR, m, n)
         U = apply_q(Ap, tau, U0, orient="N", nb=nb, precision=precision)
         return U, s, V
 
@@ -233,14 +250,14 @@ def svd(A: DistMatrix, vectors: bool = True, approach: str = "auto",
     from .funcs import polar
     Up, H = polar(A, nb=nb, precision=precision)
     if not vectors:
-        w = herm_eig(H, "L", vectors=False, nb=nb, precision=precision)
+        w = herm_eig(H, "L", vectors=False, nb=nb, approach=eig_approach,
+                     precision=precision)
         return jnp.clip(jnp.sort(w)[::-1], 0, None)
-    w, V = herm_eig(H, "L", True, nb=nb, precision=precision)
+    w, V = herm_eig(H, "L", True, nb=nb, approach=eig_approach,
+                    precision=precision)
     # H is PSD: w ascending >= 0 (up to rounding); descending order
     order = jnp.argsort(-w)
     s = jnp.clip(w[order], 0, None)
-    Vs = redistribute(V, STAR, STAR).local[:, order]
-    n_ = A.gshape[1]
-    Vd = redistribute(DistMatrix(Vs, (n_, n_), STAR, STAR, 0, 0, g), MC, MR)
+    Vd = permute_cols(V, order)
     U = gemm(Up, Vd, precision=precision)
     return U, s, Vd

@@ -1,0 +1,74 @@
+"""Interior (arbitrary-offset) extract/embed conformance.
+
+Oracle: numpy slicing of the global array (the same known-f(i,j) style as
+the redistribution conformance matrix, tests/core/test_redist.py).
+"""
+import numpy as np
+import pytest
+
+import elemental_tpu as el
+from elemental_tpu.core.dist import MC, MR, VC, VR, STAR
+from elemental_tpu.redist.interior import (interior_view, interior_update,
+                                           vstack, hstack)
+
+
+PAIRS = [(MC, MR), (MR, MC), (VC, STAR), (STAR, VR), (MC, STAR), (STAR, STAR)]
+
+
+def _mat(m, n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(m, n)).astype(np.float64)
+
+
+RANGES = [((0, 5), (0, 7)), ((3, 11), (2, 9)), ((1, 13), (5, 6)),
+          ((7, 13), (0, 11)), ((5, 6), (10, 11))]
+
+
+@pytest.mark.parametrize("pair", PAIRS, ids=lambda p: f"{p[0].value}_{p[1].value}")
+def test_interior_view(any_grid, pair):
+    m, n = 13, 11
+    F = _mat(m, n)
+    A = el.from_global(F, *pair, grid=any_grid)
+    for rows, cols in RANGES:
+        B = interior_view(A, rows, cols)
+        assert B.dist == A.dist and (B.calign, B.ralign) == (0, 0)
+        got = np.asarray(el.to_global(B))
+        np.testing.assert_allclose(got, F[rows[0]:rows[1], cols[0]:cols[1]])
+        # padding-is-zero invariant
+        assert B.local.shape == (B.col_stride * B.local_rows,
+                                 B.row_stride * B.local_cols)
+
+
+@pytest.mark.parametrize("pair", PAIRS, ids=lambda p: f"{p[0].value}_{p[1].value}")
+def test_interior_update(any_grid, pair):
+    m, n = 13, 11
+    F = _mat(m, n)
+    A = el.from_global(F, *pair, grid=any_grid)
+    for rows, cols in RANGES:
+        h, w = rows[1] - rows[0], cols[1] - cols[0]
+        G = _mat(h, w, seed=7)
+        B = el.from_global(G, *pair, grid=any_grid)
+        out = interior_update(A, B, (rows[0], cols[0]))
+        ref = F.copy()
+        ref[rows[0]:rows[1], cols[0]:cols[1]] = G
+        np.testing.assert_allclose(np.asarray(el.to_global(out)), ref)
+
+
+def test_view_update_roundtrip(grid24):
+    F = _mat(17, 15, seed=3)
+    A = el.from_global(F, MC, MR, grid=grid24)
+    B = interior_view(A, (4, 12), (3, 14))
+    out = interior_update(A, B, (4, 3))
+    np.testing.assert_allclose(np.asarray(el.to_global(out)), F)
+
+
+def test_stacks(grid24):
+    F, G = _mat(9, 6), _mat(5, 6, seed=1)
+    A = el.from_global(F, MC, MR, grid=grid24)
+    B = el.from_global(G, MC, MR, grid=grid24)
+    np.testing.assert_allclose(np.asarray(el.to_global(vstack(A, B))),
+                               np.vstack([F, G]))
+    H = _mat(9, 4, seed=2)
+    C = el.from_global(H, MC, MR, grid=grid24)
+    np.testing.assert_allclose(np.asarray(el.to_global(hstack(A, C))),
+                               np.hstack([F, H]))
