@@ -21,6 +21,7 @@ from .lapack import lu, lu_solve, lu_solve_after, permute_rows, permute_cols
 from .lapack import qr, apply_q, explicit_q, least_squares, tsqr
 from .lapack import (hermitian_tridiag, apply_q_herm_tridiag, hessenberg,
                      apply_q_hessenberg)
+from .lapack import ldl, ldl_solve_after, symmetric_solve, hermitian_solve, inertia
 from .lapack import (polar, sign, inverse, triangular_inverse, hpd_inverse,
                      pseudoinverse, square_root, hpd_square_root)
 from .lapack import herm_eig, skew_herm_eig, herm_gen_def_eig, hermitian_svd, svd

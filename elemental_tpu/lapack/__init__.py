@@ -5,6 +5,8 @@ from .lu import lu, lu_solve, lu_solve_after, permute_rows, permute_cols
 from .qr import qr, apply_q, explicit_q, least_squares, tsqr
 from .condense import (hermitian_tridiag, apply_q_herm_tridiag, hessenberg,
                        apply_q_hessenberg)
+from .ldl import (ldl, ldl_solve_after, symmetric_solve, hermitian_solve,
+                  inertia)
 from .funcs import (polar, sign, inverse, triangular_inverse, hpd_inverse,
                     pseudoinverse, square_root, hpd_square_root)
 from .spectral import (herm_eig, skew_herm_eig, herm_gen_def_eig,

@@ -9,9 +9,9 @@ reference's ``lu::Panel`` runs one MAXLOC AllReduce + one SendRecv PER
 COLUMN -- a latency wall.  Here the whole current panel is gathered to
 [STAR,STAR] (one collective) and factored REDUNDANTLY on every device with
 a local ``lax.fori_loop``: identical deterministic results everywhere, so
-pivot search costs zero communication.  Pivot row swaps touch only the
-<= 2*nb affected global rows, applied with traced gather/scatter on the
-storage array (the analog of HPL's row-broadcast swap).
+pivot search costs zero communication.  The panel's composed row
+permutation is applied to the trailing rows with one traced gather/scatter
+on the storage array (the analog of HPL's row-broadcast swap).
 
 Data-dependent pivots are traced values, so the whole factorization jits;
 the packed L\\U layout and the permutation-vector convention follow LAPACK
@@ -67,9 +67,9 @@ def _storage_row(i, r: int, lr: int):
 
 
 def _apply_swaps_storage(A: DistMatrix, T, pstep) -> DistMatrix:
-    """Apply a swap-composed permutation ``pstep`` to A's rows, touching only
-    the affected positions ``T`` (gather + scatter of <= 2*nb rows).
-    Duplicate entries in T scatter identical rows, so they are safe."""
+    """Apply a composed row permutation ``pstep`` (full-m vector) to A's
+    rows at the positions ``T`` (a gather + scatter of |T| storage rows;
+    lu() passes the whole trailing range [s, m))."""
     content = pstep[T]
     r, lr = A.col_stride, A.local_rows
     sidx = _storage_row(T, r, lr)
@@ -79,55 +79,102 @@ def _apply_swaps_storage(A: DistMatrix, T, pstep) -> DistMatrix:
     return A.with_local(stor.at[sidx].set(rows))
 
 
-def _swaps_to_perm(m: int, dests, srcs):
-    """Compose sequential swaps into a permutation vector (traced)."""
-    perm = jnp.arange(m)
-
-    def body(j, p):
-        d, sr = dests[j], srcs[j]
-        pd, ps = p[d], p[sr]
-        return p.at[d].set(ps).at[sr].set(pd)
-
-    return lax.fori_loop(0, dests.shape[0], body, perm)
-
-
 # ---------------------------------------------------------------------
 # replicated panel factorization
 # ---------------------------------------------------------------------
 
-def _panel_lu(P, nbw: int):
+def _panel_lu_unb(P, nbw: int):
     """Unblocked partial-pivot LU of a replicated (M, nbw) panel.
 
     Runs identically on every device (replicated input, deterministic) --
     the TPU answer to ``lu::Panel``'s per-column MAXLOC+SendRecv.
-    Returns (packed L\\U panel, pivot row indices within the panel)."""
+    Returns (packed L\\U panel, composed row permutation of the panel:
+    output row i came from input row perm[i])."""
     M = P.shape[0]
     ridx = jnp.arange(M)
     cidx = jnp.arange(nbw)
 
     def body(j, state):
-        P, piv = state
+        P, perm = state
         col = P[:, j]
         cand = jnp.where(ridx >= j, jnp.abs(col), -jnp.inf)
         p = jnp.argmax(cand)
-        piv = piv.at[j].set(p.astype(piv.dtype))
-        rowj = P[j]
-        rowp = P[p]
+        rowj, rowp = P[j], P[p]
         P = P.at[j].set(rowp).at[p].set(rowj)
+        pj, pp = perm[j], perm[p]
+        perm = perm.at[j].set(pp).at[p].set(pj)
         pivval = P[j, j]
         l = jnp.where(ridx > j, P[:, j] / pivval, jnp.zeros_like(col))
         P = P.at[:, j].set(jnp.where(ridx > j, l, P[:, j]))
         urow = jnp.where(cidx > j, P[j], jnp.zeros_like(P[j]))
         P = P - jnp.outer(l, urow)
-        return P, piv
+        return P, perm
 
-    piv0 = jnp.zeros((nbw,), jnp.int32)
-    return lax.fori_loop(0, nbw, body, (P, piv0))
+    return lax.fori_loop(0, nbw, body, (P, jnp.arange(M)))
+
+
+def _panel_lu(P, nbw: int, precision=None, inner: int = 64):
+    """Two-level panel: unblocked ``inner``-wide chunks + matmul-shaped
+    sub-updates.  The unblocked loop's per-column rank-1 update streams the
+    whole panel each iteration (bandwidth-bound at nbw sequential passes);
+    restricting it to an ``inner``-wide chunk cuts that traffic ~nbw/inner
+    times while the chunk-to-chunk update becomes one MXU matmul.
+
+    Returns (packed panel, composed row permutation of the panel)."""
+    if nbw <= inner:
+        return _panel_lu_unb(P, nbw)
+    M = P.shape[0]
+    perm = jnp.arange(M)
+    for s in range(0, nbw, inner):
+        e = min(s + inner, nbw)
+        w = e - s
+        sub, sperm = _panel_lu_unb(P[s:, s:e], w)      # perm rel. to row s
+        rows = jnp.take(P[s:], sperm, axis=0)          # apply swaps to block-row
+        rows = rows.at[:, s:e].set(sub)
+        if e < nbw:
+            L11 = jnp.tril(sub[:w], -1) + jnp.eye(w, dtype=P.dtype)
+            U12 = lax.linalg.triangular_solve(
+                L11, rows[:w, e:], left_side=True, lower=True,
+                unit_diagonal=True)
+            rows = rows.at[:w, e:].set(U12)
+            upd = jnp.matmul(sub[w:, :w], U12, precision=precision)
+            rows = rows.at[w:, e:].set(rows[w:, e:] - upd.astype(P.dtype))
+        P = P.at[s:].set(rows)
+        perm = perm.at[s:].set(jnp.take(perm[s:], sperm, axis=0))
+    return P, perm
 
 
 # ---------------------------------------------------------------------
 # blocked right-looking LU
 # ---------------------------------------------------------------------
+
+def _local_lu(A: DistMatrix, nb: int | None, precision):
+    """Sequential (p == 1) path: on a 1x1 grid the storage array IS the
+    global matrix, so the blocked loop fuses into one XLA program with no
+    redistribute sub-computation boundaries (the local ``Matrix<T>``
+    dispatch of the reference)."""
+    a = A.local
+    m, n = A.gshape
+    ib = max(nb or 1024, 1)
+    kend = min(m, n)
+    perm = jnp.arange(m)
+    for s in range(0, kend, ib):
+        e = min(s + ib, kend)
+        nbw = e - s
+        Pf, pperm = _panel_lu(a[s:, s:e], nbw, precision)
+        perm = perm.at[s:].set(jnp.take(perm[s:], pperm, axis=0))
+        a = a.at[s:].set(jnp.take(a[s:], pperm, axis=0))
+        a = a.at[s:, s:e].set(Pf)
+        if e < n:
+            L11 = jnp.tril(Pf[:nbw], -1) + jnp.eye(nbw, dtype=a.dtype)
+            U1n = lax.linalg.triangular_solve(
+                L11, a[s:e, e:], left_side=True, lower=True, unit_diagonal=True)
+            a = a.at[s:e, e:].set(U1n)
+            if e < m:
+                upd = jnp.matmul(Pf[nbw:], U1n, precision=precision)
+                a = a.at[e:, e:].set(a[e:, e:] - upd.astype(a.dtype))
+    return A.with_local(a), perm
+
 
 def lu(A: DistMatrix, nb: int | None = None, precision=None):
     """Blocked right-looking LU with partial pivoting.
@@ -139,6 +186,8 @@ def lu(A: DistMatrix, nb: int | None = None, precision=None):
     _check_mcmr(A)
     m, n = A.gshape
     g = A.grid
+    if g.size == 1:
+        return _local_lu(A, nb, precision)
     r, c = g.height, g.width
     ib = _blocksize(nb, math.lcm(r, c), min(m, n))
     kend = min(m, n)
@@ -151,14 +200,12 @@ def lu(A: DistMatrix, nb: int | None = None, precision=None):
         # every view to a legal boundary and column-masking the writebacks.
         e_up = min(-(-e // c) * c, n)
         panel = redistribute(view(A, rows=(s, m), cols=(s, e_up)), STAR, STAR)
-        Pf, piv = _panel_lu(panel.local[:, :nbw], nbw)
-        piv_g = piv.astype(jnp.int32) + s                # global pivot rows
-        dests = jnp.arange(s, e, dtype=jnp.int32)
-        pstep = _swaps_to_perm(m, dests, piv_g)
+        Pf, pperm = _panel_lu(panel.local[:, :nbw], nbw, precision)
+        pstep = jnp.concatenate([jnp.arange(s), pperm + s])  # full-m step perm
         perm = perm[pstep]
-        # swap the affected rows across ALL columns (the panel region is
+        # permute the trailing rows across ALL columns (the panel region is
         # overwritten by the factored panel right after)
-        A = _apply_swaps_storage(A, jnp.concatenate([dests, piv_g]), pstep)
+        A = _apply_swaps_storage(A, jnp.arange(s, m), pstep)
         # write back the factored panel (rows s..m of cols s..e)
         if e_up > e:
             Pf_w = jnp.pad(Pf, ((0, 0), (0, e_up - e)))

@@ -1,0 +1,124 @@
+"""LDL^T / LDL^H (Bunch-Kaufman) oracles.
+
+Reference test style: ``tests/lapack_like/LDL.cpp`` -- reconstruction
+residual ||P A P^T - L D L^H|| / ||A|| on indefinite matrices (incl.
+pivot-stress cases), solve residuals, and Sylvester-law inertia counts.
+"""
+import numpy as np
+import pytest
+
+import elemental_tpu as el
+from elemental_tpu.lapack.ldl import (ldl, ldl_solve_after, symmetric_solve,
+                                      hermitian_solve, inertia)
+
+
+def _g(F, grid):
+    return el.from_global(F, el.MC, el.MR, grid=grid)
+
+
+def _t(A):
+    return np.asarray(el.to_global(A))
+
+
+def _reconstruct(F, Lp, d, e, perm, conj):
+    n = F.shape[0]
+    Lg = np.tril(_t(Lp), -1) + np.eye(n)
+    dn, en, p = np.asarray(d), np.asarray(e), np.asarray(perm)
+    D = np.diag(dn.astype(complex) if np.iscomplexobj(F) else dn)
+    for j in range(n - 1):
+        if en[j] != 0:
+            D[j + 1, j] = en[j]
+            D[j, j + 1] = np.conj(en[j]) if conj else en[j]
+    PAP = F[np.ix_(p, p)]
+    rec = Lg @ D @ (Lg.conj().T if conj else Lg.T)
+    return np.linalg.norm(rec - PAP) / np.linalg.norm(F)
+
+
+def _sym(n, seed=0, cplx=False):
+    rng = np.random.default_rng(seed)
+    if cplx:
+        G = rng.normal(size=(n, n)) + 1j * rng.normal(size=(n, n))
+        return (G + G.conj().T) / 2
+    G = rng.normal(size=(n, n))
+    return (G + G.T) / 2
+
+
+def test_ldl_symmetric(grid24):
+    F = _sym(24, 0)
+    Lp, d, e, perm = ldl(_g(F, grid24), conjugate=False, nb=8)
+    assert _reconstruct(F, Lp, d, e, perm, False) < 1e-13
+
+
+def test_ldl_full_panel(grid24):
+    """nb >= n: LAPACK-faithful pivot sequence (no boundary rule)."""
+    F = _sym(24, 1)
+    Lp, d, e, perm = ldl(_g(F, grid24), conjugate=False, nb=32)
+    assert _reconstruct(F, Lp, d, e, perm, False) < 1e-13
+
+
+def test_ldl_hermitian_complex(grid24):
+    F = _sym(16, 2, cplx=True)
+    Lp, d, e, perm = ldl(_g(F, grid24), conjugate=True, nb=8)
+    assert _reconstruct(F, Lp, d, e, perm, True) < 1e-13
+    assert np.max(np.abs(np.imag(np.asarray(d)))) == 0  # real D diagonal
+
+
+def test_ldl_complex_symmetric(grid24):
+    rng = np.random.default_rng(3)
+    G = rng.normal(size=(16, 16)) + 1j * rng.normal(size=(16, 16))
+    F = (G + G.T) / 2                       # complex SYMMETRIC (no conj)
+    Lp, d, e, perm = ldl(_g(F, grid24), conjugate=False, nb=8)
+    assert _reconstruct(F, Lp, d, e, perm, False) < 1e-13
+
+
+def test_ldl_pivot_stress(grid24):
+    """Tiny diagonal forces pervasive 2x2 pivots."""
+    F = _sym(24, 4)
+    np.fill_diagonal(F, 1e-12)
+    Lp, d, e, perm = ldl(_g(F, grid24), conjugate=False, nb=8)
+    assert _reconstruct(F, Lp, d, e, perm, False) < 1e-12
+    assert np.any(np.asarray(e) != 0)       # 2x2 blocks actually used
+
+
+def test_ldl_zero_diagonal_saddle(grid24):
+    """[[0, I], [I, 0]]-like saddle: unpivoted LDL would divide by zero."""
+    n = 8
+    F = np.zeros((2 * n, 2 * n))
+    F[:n, n:] = np.eye(n)
+    F[n:, :n] = np.eye(n)
+    Lp, d, e, perm = ldl(_g(F, grid24), conjugate=False, nb=16)
+    assert _reconstruct(F, Lp, d, e, perm, False) < 1e-13
+
+
+def test_symmetric_solve(grid24):
+    rng = np.random.default_rng(5)
+    F = _sym(24, 5)
+    B = rng.normal(size=(24, 3))
+    X = symmetric_solve(_g(F, grid24), _g(B, grid24), nb=8)
+    assert np.linalg.norm(F @ _t(X) - B) / np.linalg.norm(B) < 1e-12
+
+
+def test_hermitian_solve(grid24):
+    rng = np.random.default_rng(6)
+    F = _sym(16, 6, cplx=True)
+    B = rng.normal(size=(16, 3)) + 1j * rng.normal(size=(16, 3))
+    X = hermitian_solve(_g(F, grid24), _g(B, grid24), nb=8)
+    assert np.linalg.norm(F @ _t(X) - B) / np.linalg.norm(B) < 1e-12
+
+
+def test_inertia(grid24):
+    F = _sym(24, 7)
+    _, d, e, _ = ldl(_g(F, grid24), conjugate=False, nb=8)
+    npos, nneg, nzero = inertia(d, e)
+    wn = np.linalg.eigvalsh(F)
+    assert (npos, nneg) == (int((wn > 0).sum()), int((wn < 0).sum()))
+    assert nzero == 0
+
+
+def test_ldl_uplo_upper(grid24):
+    """uplo='U' reads only the upper triangle (poison the lower)."""
+    F = _sym(16, 8)
+    P = F.copy()
+    P[np.tril_indices(16, -1)] = np.nan
+    Lp, d, e, perm = ldl(_g(P, grid24), uplo="U", conjugate=False, nb=8)
+    assert _reconstruct(F, Lp, d, e, perm, False) < 1e-13
