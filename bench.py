@@ -147,8 +147,8 @@ def main():
         return jax.random.normal(jax.random.PRNGKey(1), (n_lu, n_lu),
                                  jnp.float32)
 
-    lufn = jax.jit(lambda a: jax.tree_util.tree_map(
-        lambda x: x, tuple(el.lu(a, nb=nb, precision=HI))), donate_argnums=0)
+    lufn = jax.jit(lambda a: tuple(el.lu(a, nb=nb, precision=HI)),
+                   donate_argnums=0)
 
     def lu_step(A):
         LU, perm = lufn(A)
@@ -170,9 +170,10 @@ def main():
 
     lu_resid = float(lu_resid_fn(lu_arr, perm))
     if lu_resid > 1e-3 or lu_resid != lu_resid:
-        print(json.dumps({"metric": f"cholesky_n{n_chol}_tflops_per_chip",
+        print(json.dumps({"metric": f"lu_n{n_lu}_tflops_per_chip",
                           "value": 0.0, "unit": "TFLOP/s", "vs_baseline": 0.0,
-                          "error": f"lu residual {lu_resid:.3e}"}))
+                          "error": f"lu residual {lu_resid:.3e}",
+                          "cholesky_value": round(chol_tflops, 3)}))
         return 1
 
     print(json.dumps({

@@ -11,7 +11,7 @@ from .redist.engine import redistribute, transpose_dist
 
 __version__ = "0.2.0"
 
-from . import blas, lapack, matrices
+from . import blas, lapack, matrices, optimization
 from .blas import (gemm, herk, syrk, trrk, trsm, trr2k, her2k, syr2k,
                    hemm, symm, trmm, two_sided_trsm, two_sided_trmm,
                    multishift_trsm)
@@ -26,3 +26,5 @@ from .lapack import (polar, sign, inverse, triangular_inverse, hpd_inverse,
                      pseudoinverse, square_root, hpd_square_root)
 from .lapack import herm_eig, skew_herm_eig, herm_gen_def_eig, hermitian_svd, svd
 from .redist.interior import interior_view, interior_update, vstack, hstack
+from .optimization import (MehrotraCtrl, lp, qp, soft_threshold, svt,
+                           bp, lav, nnls, lasso, svm, rpca)

@@ -154,17 +154,30 @@ def get_diagonal(A: DistMatrix, offset: int = 0):
     return out
 
 
-def set_diagonal(A: DistMatrix, d: DistMatrix, offset: int = 0) -> DistMatrix:
-    """Write a replicated (k,1) diagonal into A."""
+def _diag_vals(A: DistMatrix, d: DistMatrix, offset: int):
+    """(on-diagonal mask, broadcast diagonal values) shared by the
+    set/update diagonal ops."""
     m, n = A.gshape
     I, J = _global_indices(A)
     on = (J[None, :] == I[:, None] + offset) \
         & (I[:, None] < m) & (J[None, :] < n)
     di = I[:, None] - (0 if offset >= 0 else -offset)
-    k = d.gshape[0]
     dv = d.local.reshape(-1)
-    vals = dv[jnp.clip(di, 0, max(k - 1, 0))]
+    vals = dv[jnp.clip(di, 0, max(dv.shape[0] - 1, 0))]
+    return on, vals
+
+
+def set_diagonal(A: DistMatrix, d: DistMatrix, offset: int = 0) -> DistMatrix:
+    """Write a replicated (k,1) diagonal into A."""
+    on, vals = _diag_vals(A, d, offset)
     return A.with_local(jnp.where(on, vals, A.local))
+
+
+def update_diagonal(A: DistMatrix, d: DistMatrix, offset: int = 0) -> DistMatrix:
+    """A += diag(d) on the given diagonal; d replicated (k,1)
+    (``El::UpdateDiagonal`` with a vector)."""
+    on, vals = _diag_vals(A, d, offset)
+    return A.with_local(jnp.where(on, A.local + vals, A.local))
 
 
 def diagonal_scale(side: str, d: DistMatrix, A: DistMatrix) -> DistMatrix:

@@ -1,0 +1,11 @@
+"""Optimization tier: Mehrotra IPMs (LP/QP), positive-orthant utilities,
+proximal operators, and models (SURVEY.md §3.5).
+
+Reference: Elemental ``src/optimization/{solvers,util,prox,models}/**``.
+"""
+from .util import MehrotraCtrl, max_step, num_outside, safe_div
+from .lp import lp
+from .qp import qp
+from .prox import (soft_threshold, svt, clip, frobenius_prox,
+                   hinge_loss_prox, logistic_prox)
+from .models import bp, lav, nnls, lasso, svm, rpca

@@ -1,0 +1,186 @@
+"""Optimization models reduced to the LP/QP solvers + prox ADMM.
+
+Reference: Elemental ``src/optimization/models/**`` -- ``BP.cpp``
+(``El::BP``: basis pursuit -> LP), ``LAV.cpp`` (least absolute value
+regression -> LP), ``NNLS.cpp`` (-> QP), ``Lasso``/BPDN (-> QP),
+``SVM.cpp`` (soft-margin -> QP), ``RPCA.cpp`` (ADMM with SVT).
+
+Each model assembles its standard form with the distributed stacking
+primitives (vstack/hstack/interior_update) and hands off to
+:func:`..optimization.lp.lp` / :func:`..optimization.qp.qp`.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.distmatrix import DistMatrix
+from ..redist.interior import interior_view, interior_update, vstack, hstack, _blank
+from ..redist.engine import redistribute, transpose_dist
+from ..core.dist import MC, MR
+from ..blas.level1 import shift_diagonal, frobenius_norm
+from ..blas.level3 import gemm
+from .lp import lp, _tp
+from .qp import qp
+from .prox import soft_threshold, svt
+from .util import MehrotraCtrl
+
+
+def _identity_like(A: DistMatrix, m: int) -> DistMatrix:
+    return shift_diagonal(_blank(m, m, A), 1)
+
+
+def _neg(A: DistMatrix) -> DistMatrix:
+    return A.with_local(-A.local)
+
+
+def _ones(A: DistMatrix, m: int) -> DistMatrix:
+    from ..blas.level1 import fill
+    return fill(_blank(m, 1, A), 1)
+
+
+def bp(A: DistMatrix, b: DistMatrix, ctrl: MehrotraCtrl | None = None,
+       nb: int | None = None, precision=None):
+    """Basis pursuit min ||x||_1 s.t. Ax = b (``El::BP``): split x = u - v,
+    LP over [u; v] >= 0."""
+    m, n = A.gshape
+    Ae = hstack(A, _neg(A))
+    ce = _ones(A, 2 * n)
+    x2, _, _, info = lp(Ae, b, ce, ctrl, nb=nb, precision=precision)
+    u = interior_view(x2, (0, n), (0, 1))
+    v = interior_view(x2, (n, 2 * n), (0, 1))
+    return u.with_local(u.local - v.local), info
+
+
+def lav(A: DistMatrix, b: DistMatrix, ctrl: MehrotraCtrl | None = None,
+        nb: int | None = None, precision=None):
+    """Least-absolute-value regression min ||Ax - b||_1 (``El::LAV``):
+    x = xp - xm, residual r = u - v, LP over [xp; xm; u; v] >= 0."""
+    m, n = A.gshape
+    I = _identity_like(A, m)
+    Ae = hstack(hstack(A, _neg(A)), hstack(I, _neg(I)))
+    cz = _blank(2 * n, 1, A)
+    co = _ones(A, 2 * m)
+    ce = vstack(cz, co)
+    x4, _, _, info = lp(Ae, b, ce, ctrl, nb=nb, precision=precision)
+    xp = interior_view(x4, (0, n), (0, 1))
+    xm = interior_view(x4, (n, 2 * n), (0, 1))
+    return xp.with_local(xp.local - xm.local), info
+
+
+def nnls(A: DistMatrix, b: DistMatrix, ctrl: MehrotraCtrl | None = None,
+         nb: int | None = None, precision=None):
+    """Nonnegative least squares min ||Ax - b||_2, x >= 0 (``El::NNLS``):
+    QP with Q = A^T A, c = -A^T b."""
+    At = _tp(A)
+    Q = gemm(At, A, nb=nb, precision=precision)
+    c = _neg(gemm(At, b, nb=nb, precision=precision))
+    x, _, _, info = qp(Q, c, ctrl=ctrl, nb=nb, precision=precision)
+    return x, info
+
+
+def lasso(A: DistMatrix, b: DistMatrix, lam: float,
+          ctrl: MehrotraCtrl | None = None, nb: int | None = None,
+          precision=None):
+    """min 1/2 ||Ax - b||^2 + lam ||x||_1 (``El::Lasso``/BPDN): split
+    x = u - v, QP over [u; v] >= 0 with Q = [[G, -G], [-G, G]]."""
+    m, n = A.gshape
+    At = _tp(A)
+    G = gemm(At, A, nb=nb, precision=precision)
+    Atb = gemm(At, b, nb=nb, precision=precision)
+    Q = _blank(2 * n, 2 * n, A)
+    Q = interior_update(Q, G, (0, 0))
+    Q = interior_update(Q, _neg(G), (0, n))
+    Q = interior_update(Q, _neg(G), (n, 0))
+    Q = interior_update(Q, G, (n, n))
+    lam1 = _ones(A, 2 * n)
+    c = vstack(_neg(Atb), Atb)
+    c = c.with_local(lam * lam1.local + c.local)
+    x2, _, _, info = qp(Q, c, ctrl=ctrl, nb=nb, precision=precision)
+    u = interior_view(x2, (0, n), (0, 1))
+    v = interior_view(x2, (n, 2 * n), (0, 1))
+    return u.with_local(u.local - v.local), info
+
+
+def svm(X: DistMatrix, labels, C: float = 1.0,
+        ctrl: MehrotraCtrl | None = None, nb: int | None = None,
+        precision=None):
+    """Soft-margin linear SVM (``El::SVM``) via the box-constrained dual
+
+        min 1/2 a^T (Y X X^T Y) a - 1^T a,  0 <= a <= C,  y^T a = 0
+
+    solved as a standard-form QP over [a; s] with a + s = C.  Returns
+    (w, bias, info)."""
+    m, n = X.gshape
+    y = jnp.asarray(labels).reshape(-1)
+    if y.shape[0] != m:
+        raise ValueError(f"labels must have length {m}")
+    Xt = _tp(X)
+    K = gemm(X, Xt, nb=nb, precision=precision)          # m x m Gram
+    from ..core.distmatrix import to_global, from_global
+    # Y K Y scaling is a rank-structured elementwise op: do it via the
+    # replicated label vector on storage index maps
+    from ..blas.level1 import _global_indices
+    I, J = _global_indices(K)
+    yI = y[jnp.clip(I, 0, m - 1)][:, None]
+    yJ = y[jnp.clip(J, 0, m - 1)][None, :]
+    Kyy = K.with_local(K.local * yI * yJ)
+    Q = _blank(2 * m, 2 * m, X)
+    Q = interior_update(Q, Kyy, (0, 0))
+    c = vstack(_neg(_ones(X, m)), _blank(m, 1, X))
+    # equality constraints: y^T a = 0;  a + s = C
+    yrow = from_global(np.asarray(y, np.float64).reshape(1, -1)
+                       .astype(np.dtype(X.dtype)), MC, MR, grid=X.grid)
+    Arow = hstack(yrow, _blank(1, m, X))
+    I_m = _identity_like(X, m)
+    Abox = hstack(I_m, I_m)
+    Ae = vstack(Arow, Abox)
+    be = vstack(_blank(1, 1, X), _ones(X, m).with_local(
+        C * _ones(X, m).local))
+    x2, _, _, info = qp(Q, c, Ae, be, ctrl=ctrl, nb=nb, precision=precision)
+    a = interior_view(x2, (0, m), (0, 1))
+    # w = X^T (a . y);  bias from margin support vectors (0 < a < C)
+    ay = a.with_local(a.local * y[jnp.clip(_global_indices(a)[0], 0, m - 1)][:, None])
+    w = gemm(Xt, ay, nb=nb, precision=precision)
+    ag = np.asarray(to_global(a)).ravel()
+    Xg = np.asarray(to_global(X))
+    wg = np.asarray(to_global(w)).ravel()
+    sv = (ag > 1e-6 * C) & (ag < (1 - 1e-6) * C)
+    yn = np.asarray(y)
+    bias = float(np.mean(yn[sv] - Xg[sv] @ wg)) if np.any(sv) else 0.0
+    return w, bias, info
+
+
+def rpca(M: DistMatrix, lam: float | None = None, tol: float = 1e-6,
+         max_iters: int = 100, nb: int | None = None, precision=None):
+    """Robust PCA min ||L||_* + lam ||S||_1 s.t. L + S = M (``El::RPCA``,
+    ALM/ADMM with singular-value thresholding).  Returns (L, S, info)."""
+    m, n = M.gshape
+    lam = lam if lam is not None else 1.0 / math.sqrt(max(m, n))
+    normM = float(frobenius_norm(M))
+    # canonical IALM parameters (Lin-Chen-Ma): Y0 = M / J(M), mu0 = 1.25/||M||_2
+    from ..lapack.spectral import svd as _svd
+    s2 = float(_svd(M, vectors=False, nb=nb, precision=precision)[0])
+    ninf = float(jnp.max(jnp.abs(M.local)))
+    J = max(s2, ninf / lam, 1e-300)
+    S = M.with_local(jnp.zeros_like(M.local))
+    Y = M.with_local(M.local / J)
+    mu = 1.25 / max(s2, 1e-300)
+    mu_max = mu * 1e7
+    info = {"iters": 0, "converged": False}
+    for it in range(max_iters):
+        L = svt(M.with_local(M.local - S.local + Y.local / mu), 1.0 / mu,
+                nb=nb, precision=precision)
+        S = soft_threshold(M.with_local(M.local - L.local + Y.local / mu),
+                           lam / mu)
+        R = M.with_local(M.local - L.local - S.local)
+        Y = Y.with_local(Y.local + mu * R.local)
+        mu = min(1.5 * mu, mu_max)          # inexact-ALM penalty growth
+        err = float(frobenius_norm(R)) / max(normM, 1e-300)
+        info.update(iters=it, err=err)
+        if err < tol:
+            info["converged"] = True
+            break
+    return L, S, info

@@ -1,0 +1,52 @@
+"""Positive-orthant cone utilities + IPM control structs.
+
+Reference: Elemental ``src/optimization/util/PosOrth/**`` (``El::pos_orth``:
+``MaxStep``, ``NumOutside``, complementarity helpers) and the ``MehrotraCtrl``
+tuning struct (``include/El/optimization/solvers.hpp``), mapped to a plain
+dataclass per SURVEY.md §6.6.
+
+Vectors are (k, 1) [MC,MR] DistMatrices; elementwise cone ops run directly
+on storage arrays (each entry exactly once, padding zero -- guarded where a
+zero denominator could poison the result).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from ..core.distmatrix import DistMatrix
+
+
+@dataclasses.dataclass(frozen=True)
+class MehrotraCtrl:
+    """Tolerances/switches for the Mehrotra predictor-corrector IPMs
+    (``El::MehrotraCtrl``)."""
+    tol: float = 1e-8
+    max_iters: int = 100
+    eta: float = 0.995          # fraction-to-the-boundary damping
+    init_shift: float = 10.0    # Mehrotra initialization delta scaling
+    print_progress: bool = False
+
+
+def safe_div(a, b):
+    """a / b with 0/0 -> 0 (padding-safe elementwise divide)."""
+    return jnp.where(b != 0, a / jnp.where(b == 0, 1, b), 0)
+
+
+def max_step(x: DistMatrix, dx: DistMatrix, cap: float = 1.0):
+    """sup {alpha <= cap : x + alpha dx >= 0} for interior x > 0
+    (``El::pos_orth::MaxStep``).  Returns a traced scalar."""
+    ratio = jnp.where(dx.local < 0, -safe_div(x.local, dx.local), jnp.inf)
+    return jnp.minimum(jnp.min(ratio), cap)
+
+
+def num_outside(x: DistMatrix):
+    """Entries strictly outside the cone (``pos_orth::NumOutside``);
+    padding zeros count as on the boundary, not outside."""
+    return jnp.sum(x.local < 0)
+
+
+def shift_interior(v: DistMatrix, valid_mask, delta):
+    """v + delta on the valid entries (keeps padding zero)."""
+    return v.with_local(jnp.where(valid_mask, v.local + delta, 0))

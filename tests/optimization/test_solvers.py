@@ -1,0 +1,170 @@
+"""IPM + prox + model oracles.
+
+Reference test style: the ``examples/optimization/*`` drivers check
+objective/duality-gap convergence (SURVEY.md §5); here we add ground-truth
+comparisons (KKT conditions, sparse recovery, scipy cross-checks where
+available).
+"""
+import numpy as np
+import pytest
+
+import elemental_tpu as el
+from elemental_tpu.optimization.util import MehrotraCtrl
+
+
+def _dm(F, grid):
+    return el.from_global(F, el.MC, el.MR, grid=grid)
+
+
+def _t(A):
+    return np.asarray(el.to_global(A))
+
+
+def _feasible_lp(rng, m, n):
+    A = rng.normal(size=(m, n))
+    x0 = rng.uniform(0.5, 2.0, size=(n, 1))
+    b = A @ x0
+    y0 = rng.normal(size=(m, 1))
+    z0 = rng.uniform(0.5, 2.0, size=(n, 1))
+    c = A.T @ y0 + z0
+    return A, b, c
+
+
+def test_lp_mehrotra(grid24):
+    rng = np.random.default_rng(0)
+    A, b, c = _feasible_lp(rng, 10, 24)
+    x, y, z, info = el.lp(_dm(A, grid24), _dm(b, grid24), _dm(c, grid24))
+    assert info["converged"] and info["rel_gap"] < 1e-8
+    xg, yg, zg = _t(x), _t(y), _t(z)
+    assert np.linalg.norm(A @ xg - b) / np.linalg.norm(b) < 1e-7
+    assert np.linalg.norm(A.T @ yg + zg - c) / np.linalg.norm(c) < 1e-7
+    assert xg.min() > -1e-10 and zg.min() > -1e-10
+    assert abs(float(c.T @ xg) - float(b.T @ yg)) < 1e-6 * (1 + abs(float(c.T @ xg)))
+
+
+def test_lp_vs_scipy(grid24):
+    scipy_opt = pytest.importorskip("scipy.optimize")
+    rng = np.random.default_rng(1)
+    A, b, c = _feasible_lp(rng, 8, 20)
+    x, _, _, info = el.lp(_dm(A, grid24), _dm(b, grid24), _dm(c, grid24))
+    res = scipy_opt.linprog(c.ravel(), A_eq=A, b_eq=b.ravel(),
+                            bounds=(0, None), method="highs")
+    assert abs(float(c.T @ _t(x)) - res.fun) < 1e-6 * (1 + abs(res.fun))
+
+
+def test_qp_equality(grid24):
+    rng = np.random.default_rng(2)
+    n, m = 12, 4
+    G0 = rng.normal(size=(n, n))
+    Q = G0 @ G0.T / n + np.eye(n)
+    A = rng.normal(size=(m, n))
+    b = A @ rng.uniform(0.5, 1.5, size=(n, 1))
+    c = rng.normal(size=(n, 1))
+    x, y, z, info = el.qp(_dm(Q, grid24), _dm(c, grid24), _dm(A, grid24),
+                          _dm(b, grid24))
+    assert info["converged"]
+    xg, yg, zg = _t(x), _t(y), _t(z)
+    assert np.linalg.norm(A @ xg - b) < 1e-7 * (1 + np.linalg.norm(b))
+    # stationarity: Qx + c - A^T y - z = 0
+    r = Q @ xg + c - A.T @ yg - zg
+    assert np.linalg.norm(r) < 1e-6 * (1 + np.linalg.norm(c))
+    assert float(xg.T @ zg) < 1e-6
+
+
+def test_nnls(grid24):
+    rng = np.random.default_rng(3)
+    A = rng.normal(size=(20, 10))
+    b = rng.normal(size=(20, 1))
+    x, info = el.nnls(_dm(A, grid24), _dm(b, grid24))
+    xg = _t(x)
+    assert xg.min() > -1e-9
+    scipy_opt = pytest.importorskip("scipy.optimize")
+    xs, _ = scipy_opt.nnls(A, b.ravel())
+    assert np.linalg.norm(xg.ravel() - xs) < 1e-6
+
+
+def test_bp_sparse_recovery(grid24):
+    rng = np.random.default_rng(4)
+    m, n = 10, 24
+    A = rng.normal(size=(m, n))
+    x_true = np.zeros((n, 1))
+    x_true[[2], [0]] = 1.5
+    x_true[[9], [0]] = -2.0
+    x_true[[17], [0]] = 0.7
+    b = A @ x_true
+    x, info = el.bp(_dm(A, grid24), _dm(b, grid24))
+    assert np.linalg.norm(_t(x) - x_true) < 1e-6
+
+
+def test_lav_outlier_robust(grid24):
+    rng = np.random.default_rng(5)
+    A = rng.normal(size=(24, 6))
+    x_true = rng.normal(size=(6, 1))
+    b = A @ x_true
+    b[3] += 10.0                              # gross outlier
+    x, info = el.lav(_dm(A, grid24), _dm(b, grid24))
+    assert np.linalg.norm(_t(x) - x_true) < 1e-6
+
+
+def test_lasso_shrinks(grid24):
+    rng = np.random.default_rng(6)
+    A = rng.normal(size=(16, 8))
+    b = rng.normal(size=(16, 1))
+    x, info = el.lasso(_dm(A, grid24), _dm(b, grid24), lam=2.0)
+    xg = _t(x)
+    # KKT: |A^T(Ax - b)| <= lam (+ slack at active entries)
+    kkt = A.T @ (A @ xg - b)
+    assert np.all(np.abs(kkt) <= 2.0 + 1e-6)
+
+
+def test_svm_separable(grid24):
+    rng = np.random.default_rng(7)
+    X = np.vstack([rng.normal(size=(12, 4)) + 2,
+                   rng.normal(size=(12, 4)) - 2])
+    y = np.concatenate([np.ones(12), -np.ones(12)])
+    w, bias, info = el.svm(_dm(X, grid24), y, C=10.0)
+    pred = np.sign(X @ _t(w).ravel() + bias)
+    assert (pred == y).all()
+
+
+def test_rpca_recovery(grid24):
+    rng = np.random.default_rng(8)
+    n = 60
+    L0 = rng.normal(size=(n, 3)) @ rng.normal(size=(3, n))
+    S0 = np.zeros((n, n))
+    idx = rng.choice(n * n, n * n // 20, replace=False)
+    S0.flat[idx] = rng.normal(size=len(idx)) * 5
+    L, S, info = el.rpca(_dm(L0 + S0, grid24), tol=1e-7)
+    assert info["converged"]
+    assert np.linalg.norm(_t(L) - L0) / np.linalg.norm(L0) < 1e-5
+
+
+def test_prox_operators(grid24):
+    rng = np.random.default_rng(9)
+    F = rng.normal(size=(9, 7))
+    A = _dm(F, grid24)
+    st = _t(el.soft_threshold(A, 0.5))
+    assert np.allclose(st, np.sign(F) * np.maximum(np.abs(F) - 0.5, 0))
+    from elemental_tpu.optimization.prox import clip, svt
+    cl = _t(clip(A, -0.3, 0.3))
+    assert np.allclose(cl, np.clip(F, -0.3, 0.3))
+    # SVT: singular values soft-thresholded
+    sv = _t(svt(A, 0.8))
+    U, s, Vh = np.linalg.svd(F, full_matrices=False)
+    ref = (U * np.maximum(s - 0.8, 0)) @ Vh
+    assert np.linalg.norm(sv - ref) < 1e-9
+
+
+def test_logistic_prox(grid24):
+    """prox minimizes rho/2 (x-a)^2 + log(1+e^{-x}) -- check against a
+    dense grid search."""
+    from elemental_tpu.optimization.prox import logistic_prox
+    rng = np.random.default_rng(10)
+    F = rng.normal(size=(5, 3)) * 2
+    A = _dm(F, grid24)
+    rho = 0.5
+    got = _t(logistic_prox(A, rho, newton_iters=30))
+    grid_x = np.linspace(-20, 20, 400001)
+    for a, x in zip(F.ravel(), got.ravel()):
+        obj = rho / 2 * (grid_x - a) ** 2 + np.log1p(np.exp(-grid_x))
+        assert abs(x - grid_x[np.argmin(obj)]) < 1e-3
