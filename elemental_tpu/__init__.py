@@ -11,7 +11,7 @@ from .redist.engine import redistribute, transpose_dist
 
 __version__ = "0.2.0"
 
-from . import blas, lapack, matrices, optimization
+from . import blas, lapack, matrices, optimization, control
 from .blas import (gemm, herk, syrk, trrk, trsm, trr2k, her2k, syr2k,
                    hemm, symm, trmm, two_sided_trsm, two_sided_trmm,
                    multishift_trsm)
@@ -28,3 +28,5 @@ from .lapack import herm_eig, skew_herm_eig, herm_gen_def_eig, hermitian_svd, sv
 from .redist.interior import interior_view, interior_update, vstack, hstack
 from .optimization import (MehrotraCtrl, lp, qp, soft_threshold, svt,
                            bp, lav, nnls, lasso, svm, rpca)
+from .control import sylvester, lyapunov, riccati
+from .lapack.schur import schur, triang_eig, eig, pseudospectra

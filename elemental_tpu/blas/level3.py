@@ -494,9 +494,19 @@ def two_sided_trmm(uplo: str, A: DistMatrix, L: DistMatrix,
 # MultiShiftTrsm (the Pseudospectra / TriangEig engine)
 # ---------------------------------------------------------------------
 
+def _star_vr_colmap(n: int, p: int):
+    """Static [STAR,VR] storage-column -> global-column map (zero align):
+    (clipped global index per storage column, in-range mask)."""
+    lc = -(-n // p)
+    q = np.arange(p)[:, None]
+    jl = np.arange(lc)[None, :]
+    perm = (jl * p + q).reshape(-1)
+    return jnp.asarray(np.clip(perm, 0, n - 1)), jnp.asarray(perm < n)
+
+
 def multishift_trsm(uplo: str, orient: str, A: DistMatrix, shifts,
                     B: DistMatrix, alpha=1.0, nb: int | None = None,
-                    precision=None) -> DistMatrix:
+                    precision=None, diag_hook=None) -> DistMatrix:
     """Solve (op(tri(A)) - shifts[j] I) X[:, j] = alpha B[:, j] for all j at
     once (``El::MultiShiftTrsm``, ``src/blas_like/level3/MultiShiftTrsm/``).
 
@@ -504,7 +514,11 @@ def multishift_trsm(uplo: str, orient: str, A: DistMatrix, shifts,
     column-batched shifted triangular solve on the [STAR,VR] panel (each
     storage column's shift selected by the static cyclic column permutation
     -- pure local, zero extra communication), and the trailing update is
-    shift-free (shifts only touch diagonal blocks)."""
+    shift-free (shifts only touch diagonal blocks).
+
+    ``diag_hook(M, sigma, global_col, global_rows)``, if given, may rewrite
+    the shifted diagonal block per column before the solve (TriangEig's
+    identity-row replacement rides this)."""
     trans = orient in ("T", "C")
     conj = orient == "C"
     _check_mcmr(A, B)
@@ -519,13 +533,8 @@ def multishift_trsm(uplo: str, orient: str, A: DistMatrix, shifts,
     r, c = g.height, g.width
     p = r * c
     ib = _blocksize(nb, math.lcm(r, c), m)
-    # static [STAR,VR] storage-column -> global-column map (zero align)
-    lc = -(-n // p)
-    q = np.arange(p)[:, None]
-    jl = np.arange(lc)[None, :]
-    perm = (jl * p + q).reshape(-1)
-    sig_stor = jnp.take(shifts, jnp.asarray(np.clip(perm, 0, n - 1)))
-    sig_stor = jnp.where(jnp.asarray(perm) < n, sig_stor, 0)
+    gcol, in_range = _star_vr_colmap(n, p)
+    sig_stor = jnp.where(in_range, jnp.take(shifts, gcol), 0)
     # (op(M) - sigma I) = op(M - sigma' I): diagonal untouched by T, conj by C
     sig_eff = jnp.conj(sig_stor) if conj else sig_stor
 
@@ -541,14 +550,18 @@ def multishift_trsm(uplo: str, orient: str, A: DistMatrix, shifts,
         B1 = redistribute(view(X, rows=(s, e)), STAR, VR)
         d = a11.shape[0]
         eye = jnp.eye(d, dtype=a11.dtype)
+        rowg = s + jnp.arange(d)
 
-        def _one(sg, b):
+        def _one(sg, jg, b):
+            M = a11 - sg * eye
+            if diag_hook is not None:
+                M = diag_hook(M, sg, jg, rowg)
             return lax.linalg.triangular_solve(
-                a11 - sg * eye, b[:, None], left_side=True, lower=lower,
+                M, b[:, None], left_side=True, lower=lower,
                 transpose_a=trans, conjugate_a=conj)[:, 0]
 
-        x1 = jax.vmap(_one, in_axes=(0, 1), out_axes=1)(
-            sig_eff.astype(a11.dtype), B1.local)
+        x1 = jax.vmap(_one, in_axes=(0, 0, 1), out_axes=1)(
+            sig_eff.astype(a11.dtype), gcol, B1.local)
         X1 = DistMatrix(x1, B1.gshape, STAR, VR, 0, 0, g)
         X1_mr = redistribute(X1, STAR, MR)
         X = update_view(X, redistribute(X1_mr, MC, MR), rows=(s, e))

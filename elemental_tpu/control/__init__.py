@@ -1,0 +1,5 @@
+"""Control theory layer (SURVEY.md §3.5): sign-function solvers.
+
+Reference: Elemental ``src/control/``.
+"""
+from .core import sylvester, lyapunov, riccati

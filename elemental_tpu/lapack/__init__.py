@@ -11,3 +11,4 @@ from .funcs import (polar, sign, inverse, triangular_inverse, hpd_inverse,
                     pseudoinverse, square_root, hpd_square_root)
 from .spectral import (herm_eig, skew_herm_eig, herm_gen_def_eig,
                        hermitian_svd, svd)
+from .schur import schur, triang_eig, eig, pseudospectra

@@ -15,8 +15,6 @@ A A^T, shifted to the interior) reuses the same Cholesky machinery.
 """
 from __future__ import annotations
 
-import math
-
 import jax.numpy as jnp
 import numpy as np
 

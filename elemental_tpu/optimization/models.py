@@ -18,7 +18,6 @@ import numpy as np
 
 from ..core.distmatrix import DistMatrix
 from ..redist.interior import interior_view, interior_update, vstack, hstack, _blank
-from ..redist.engine import redistribute, transpose_dist
 from ..core.dist import MC, MR
 from ..blas.level1 import shift_diagonal, frobenius_norm
 from ..blas.level3 import gemm

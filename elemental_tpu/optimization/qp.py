@@ -23,7 +23,6 @@ import numpy as np
 
 from ..core.dist import MC, MR
 from ..core.distmatrix import DistMatrix
-from ..redist.engine import redistribute, transpose_dist
 from ..redist.interior import interior_view, interior_update, vstack, _blank
 from ..blas.level1 import _valid_mask, update_diagonal
 from ..blas.level3 import _check_mcmr, gemm

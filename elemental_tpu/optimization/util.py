@@ -46,7 +46,3 @@ def num_outside(x: DistMatrix):
     padding zeros count as on the boundary, not outside."""
     return jnp.sum(x.local < 0)
 
-
-def shift_interior(v: DistMatrix, valid_mask, delta):
-    """v + delta on the valid entries (keeps padding zero)."""
-    return v.with_local(jnp.where(valid_mask, v.local + delta, 0))

@@ -1,0 +1,54 @@
+"""Sylvester / Lyapunov / Riccati oracles (residuals + scipy cross-check)."""
+import numpy as np
+import pytest
+
+import elemental_tpu as el
+
+
+def _dm(F, grid):
+    return el.from_global(F, el.MC, el.MR, grid=grid)
+
+
+def _t(A):
+    return np.asarray(el.to_global(A))
+
+
+def _stable(rng, n):
+    A = rng.normal(size=(n, n))
+    return A - (np.abs(np.linalg.eigvals(A).real).max() + 1) * np.eye(n)
+
+
+def test_sylvester(grid24):
+    scipy_linalg = pytest.importorskip("scipy.linalg")
+    rng = np.random.default_rng(0)
+    A, B = _stable(rng, 12), _stable(rng, 8)
+    C = rng.normal(size=(12, 8))
+    X = _t(el.sylvester(_dm(A, grid24), _dm(B, grid24), _dm(C, grid24)))
+    assert np.linalg.norm(A @ X + X @ B - C) / np.linalg.norm(C) < 1e-12
+    Xs = scipy_linalg.solve_sylvester(A, B, C)
+    assert np.linalg.norm(X - Xs) / np.linalg.norm(Xs) < 1e-12
+
+
+def test_lyapunov(grid24):
+    rng = np.random.default_rng(1)
+    A = _stable(rng, 12)
+    C = rng.normal(size=(12, 12))
+    C = C + C.T
+    X = _t(el.lyapunov(_dm(A, grid24), _dm(C, grid24)))
+    assert np.linalg.norm(A @ X + X @ A.T - C) / np.linalg.norm(C) < 1e-12
+
+
+def test_riccati(grid24):
+    scipy_linalg = pytest.importorskip("scipy.linalg")
+    rng = np.random.default_rng(2)
+    n, k = 8, 3
+    A = rng.normal(size=(n, n))
+    B = rng.normal(size=(n, k))
+    G = B @ B.T
+    Q = rng.normal(size=(n, n))
+    Q = Q @ Q.T / n + np.eye(n)
+    X = _t(el.riccati(_dm(A, grid24), _dm(G, grid24), _dm(Q, grid24)))
+    r = A.T @ X + X @ A + Q - X @ G @ X
+    assert np.linalg.norm(r) / np.linalg.norm(Q) < 1e-10
+    Xs = scipy_linalg.solve_continuous_are(A, B, Q, np.eye(k))
+    assert np.linalg.norm(X - Xs) / np.linalg.norm(Xs) < 1e-10

@@ -1,0 +1,101 @@
+"""Schur / TriangEig / Eig / Pseudospectra oracles.
+
+Reference test style: Schur residual ||A - Q T Q^H||/||A||, unitarity,
+triangularity, eigenvalue-multiset agreement; TriangEig residuals; a
+pseudospectra map checked against directly computed sigma_min values.
+"""
+import numpy as np
+import pytest
+
+import elemental_tpu as el
+from elemental_tpu.lapack.schur import schur, triang_eig, eig, pseudospectra
+
+
+def _dm(F, grid):
+    return el.from_global(F, el.MC, el.MR, grid=grid)
+
+
+def _t(A):
+    return np.asarray(el.to_global(A))
+
+
+def _check_schur(F, T, Q, tol=1e-12):
+    n = F.shape[0]
+    Tg, Qg = _t(T), _t(Q)
+    assert np.linalg.norm(np.tril(Tg, -1)) == 0
+    assert np.linalg.norm(Qg.conj().T @ Qg - np.eye(n)) < tol * n
+    assert np.linalg.norm(F - Qg @ Tg @ Qg.conj().T) / np.linalg.norm(F) < tol
+    ev = np.linalg.eigvals(F)
+    got = np.diag(Tg)
+    d = np.abs(ev[:, None] - got[None, :])
+    assert d.min(axis=1).max() < 1e-10 * max(np.abs(ev).max(), 1)
+
+
+def test_schur_sdc_real(grid24):
+    """base=12 forces >= 2 SDC levels on a real nonsymmetric matrix."""
+    rng = np.random.default_rng(0)
+    F = rng.normal(size=(40, 40))
+    T, Q = schur(_dm(F, grid24), base=12)
+    _check_schur(F, T, Q)
+
+
+def test_schur_sdc_complex(grid24):
+    rng = np.random.default_rng(1)
+    F = rng.normal(size=(24, 24)) + 1j * rng.normal(size=(24, 24))
+    T, Q = schur(_dm(F, grid24), base=8)
+    _check_schur(F, T, Q)
+
+
+def test_schur_replicated_base(grid24):
+    rng = np.random.default_rng(2)
+    F = rng.normal(size=(16, 16))
+    T, Q = schur(_dm(F, grid24))         # n < default base: hseqr fallback
+    _check_schur(F, T, Q)
+
+
+def test_triang_eig(grid24):
+    import scipy.linalg
+    rng = np.random.default_rng(3)
+    F = rng.normal(size=(40, 40))
+    Tn, _ = scipy.linalg.schur(F, output="complex")
+    w, V = triang_eig(_dm(Tn, grid24), nb=8)
+    Vg, wg = _t(V), np.asarray(w)
+    R = Tn @ Vg - Vg @ np.diag(wg)
+    assert np.linalg.norm(R, axis=0).max() < 1e-12 * np.linalg.norm(Tn)
+    assert np.allclose(np.linalg.norm(Vg, axis=0), 1.0, atol=1e-12)
+
+
+def test_triang_eig_defective(grid24):
+    """Repeated/defective eigenvalues (Jordan block) must yield finite,
+    unit-norm vectors via the smin pivot clamp, not NaN columns."""
+    T = np.triu(np.ones((8, 8))) * 0.3
+    np.fill_diagonal(T, [1.0, 1.0, 2.0, 2.0, 2.0, 3.0, 4.0, 5.0])
+    T[0, 1] = 1.0                                  # explicit Jordan coupling
+    w, V = triang_eig(_dm(T.astype(complex), grid24), nb=8)
+    Vg = _t(V)
+    assert np.all(np.isfinite(Vg))
+    assert np.allclose(np.linalg.norm(Vg, axis=0), 1.0, atol=1e-10)
+    # distinct-eigenvalue columns are still exact eigenvectors
+    R = T @ Vg - Vg @ np.diag(np.asarray(w))
+    cols = np.linalg.norm(R, axis=0)
+    assert cols[[5, 6, 7]].max() < 1e-10
+
+
+def test_eig_general(grid24):
+    rng = np.random.default_rng(4)
+    F = rng.normal(size=(40, 40))
+    w, V = eig(_dm(F, grid24), base=12)
+    Vg, wg = _t(V), np.asarray(w)
+    r = F.astype(complex) @ Vg - Vg @ np.diag(wg)
+    assert np.linalg.norm(r) / np.linalg.norm(F) < 1e-11
+
+
+def test_pseudospectra_map(grid24):
+    rng = np.random.default_rng(5)
+    F = rng.normal(size=(32, 32))
+    Z, sm = pseudospectra(_dm(F, grid24), (-3, 3), (-3, 3), nx=4, ny=4,
+                          iters=14, base=64)
+    direct = np.array([[np.linalg.svd(F - z * np.eye(32),
+                                      compute_uv=False)[-1]
+                        for z in row] for row in Z])
+    assert np.max(np.abs(sm - direct) / np.maximum(direct, 1e-12)) < 1e-3
