@@ -20,7 +20,7 @@ from .lapack import cholesky, hpd_solve, cholesky_solve_after
 from .lapack import lu, lu_solve, lu_solve_after, permute_rows, permute_cols
 from .lapack import qr, apply_q, explicit_q, least_squares, tsqr
 from .lapack import (hermitian_tridiag, apply_q_herm_tridiag, hessenberg,
-                     apply_q_hessenberg)
+                     apply_q_hessenberg, bidiag, apply_p_bidiag)
 from .lapack import ldl, ldl_solve_after, symmetric_solve, hermitian_solve, inertia
 from .lapack import (polar, sign, inverse, triangular_inverse, hpd_inverse,
                      pseudoinverse, square_root, hpd_square_root)

@@ -4,7 +4,7 @@ from .cholesky import cholesky, hpd_solve, cholesky_solve_after
 from .lu import lu, lu_solve, lu_solve_after, permute_rows, permute_cols
 from .qr import qr, apply_q, explicit_q, least_squares, tsqr
 from .condense import (hermitian_tridiag, apply_q_herm_tridiag, hessenberg,
-                       apply_q_hessenberg)
+                       apply_q_hessenberg, bidiag, apply_p_bidiag)
 from .ldl import (ldl, ldl_solve_after, symmetric_solve, hermitian_solve,
                   inertia)
 from .funcs import (polar, sign, inverse, triangular_inverse, hpd_inverse,

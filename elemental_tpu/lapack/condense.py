@@ -35,7 +35,8 @@ from ..core.dist import MC, MR, STAR
 from ..core.distmatrix import DistMatrix
 from ..core.view import view, update_view, round_up
 from ..redist.engine import redistribute, transpose_dist
-from ..blas.level2 import hemv
+from ..blas.level2 import gemv, hemv
+from ..blas.level1 import _global_indices
 from ..blas.level3 import _blocksize, _check_mcmr, _mask_triangle
 from .lu import _update_cols_lt
 from .qr import _larft
@@ -55,23 +56,29 @@ def _unwrap_vec(x: DistMatrix):
     return redistribute(x, STAR, STAR).local[:, 0]
 
 
-def _larfg_tail(col, jj, ridx, dtype):
-    """Householder reflector zeroing rows > jj+1 of ``col`` (LAPACK larfg:
-    real beta, H = I - tau v v^H with implicit v[jj+1] = 1)."""
-    alpha = col[jj + 1]
-    tail2 = jnp.where(ridx > jj + 1, col, 0)
+def _larfg_at(col, piv, ridx, dtype):
+    """Householder reflector pivoting at row ``piv`` (zeroes rows > piv):
+    real beta, H = I - tau v v^H, implicit v[piv] = 1."""
+    alpha = col[piv]
+    tail2 = jnp.where(ridx > piv, col, 0)
     sigma = jnp.sum(jnp.abs(tail2) ** 2)
     anorm = jnp.sqrt(jnp.abs(alpha) ** 2 + sigma)
     re_a = jnp.real(alpha)
-    beta = -jnp.sign(jnp.where(re_a == 0, 1.0, re_a)) * anorm      # real
+    beta = -jnp.sign(jnp.where(re_a == 0, 1.0, re_a)) * anorm
     degenerate = anorm == 0
     safe_beta = jnp.where(degenerate, 1.0, beta)
     tau = jnp.where(degenerate, 0.0, (safe_beta - alpha) / safe_beta)
     denom = alpha - safe_beta
     safe_denom = jnp.where(denom == 0, 1.0, denom)
-    v = jnp.where(ridx > jj + 1, col / safe_denom, 0)
-    v = jnp.where(ridx == jj + 1, jnp.ones((), dtype), v)
+    v = jnp.where(ridx > piv, col / safe_denom, 0)
+    v = jnp.where(ridx == piv, jnp.ones((), dtype), v)
     return v.astype(dtype), jnp.asarray(tau, dtype), beta
+
+
+def _larfg_tail(col, jj, ridx, dtype):
+    """Householder reflector zeroing rows > jj+1 of ``col`` (LAPACK larfg:
+    real beta, H = I - tau v v^H with implicit v[jj+1] = 1)."""
+    return _larfg_at(col, jj + 1, ridx, dtype)
 
 
 @partial(jax.jit, static_argnums=(2, 3, 4))
@@ -248,6 +255,214 @@ def apply_q_herm_tridiag(Ap: DistMatrix, tau, B: DistMatrix,
         Tm = jnp.conj(T).T if orient == "C" else T
         V_mc = redistribute(
             DistMatrix(V, (n - s, nbw), STAR, STAR, 0, 0, g), MC, STAR)
+        B2 = view(B, rows=(s, n))
+        Wl = jnp.matmul(jnp.conj(V_mc.local).T, B2.local, precision=precision)
+        Wl = jnp.matmul(Tm, Wl, precision=precision)
+        upd = jnp.matmul(V_mc.local, Wl, precision=precision)
+        B = update_view(B, B2.with_local(B2.local - upd.astype(B.dtype)),
+                        rows=(s, n))
+    return B
+
+
+# ---------------------------------------------------------------------
+# Bidiagonal reduction (the SVD condense step)
+# ---------------------------------------------------------------------
+
+@partial(jax.jit, static_argnums=(3, 4))
+def _bidiag_panel(Atrail: DistMatrix, Pc, Pr, nbw: int, precision):
+    """labrd: reduce ``nbw`` columns AND rows of the (mt, nt) trailing view.
+
+    ``Pc``/``Pr``: replicated panel columns (mt, nbw) / rows (nbw, nt) at
+    panel start.  The running matrix is ``A0 - U Y^H - X V^H``; per column
+    the two distributed ops are one ``gemv^H`` (building Y) and one ``gemv``
+    (building X) against the FIXED trailing view -- the reference's
+    ``bidiag::PanelBidiag`` distributed products."""
+    mt, nt = Atrail.gshape
+    g = Atrail.grid
+    dtype = Pc.dtype
+    rdtype = _real_dtype(dtype)
+    ridx = jnp.arange(mt)
+    cidx = jnp.arange(nt)
+
+    def body(j, carry):
+        U, Y, V, X, d, e, tauq, taup = carry
+        # current column j
+        col = Pc[:, j] - U @ jnp.conj(Y[j, :]) - X @ jnp.conj(V[j, :])
+        u, tq, beta = _larfg_at(col, j, ridx, dtype)
+        d = d.at[j].set(beta.astype(rdtype))
+        # zlarfg: H^H x = beta e, so the left update A <- H^H A is
+        # A - u y^H with y = tq * A_cur^H u
+        base = _unwrap_vec(gemv(Atrail, _wrap_vec(u, g), orient="C",
+                                precision=precision))
+        y = base - Y @ (jnp.conj(U).T @ u) - V @ (jnp.conj(X).T @ u)
+        y = (tq * y).astype(dtype)
+        U = U.at[:, j].set(u)
+        Y = Y.at[:, j].set(y)
+        tauq = tauq.at[j].set(tq)
+        # current row j (after the left update): right reflector at col j+1
+        row = Pr[j, :] - U[j, :] @ jnp.conj(Y).T - X[j, :] @ jnp.conj(V).T
+        do_right = j + 1 < nt
+        rbar = jnp.conj(row)
+        v, tp, betar = _larfg_at(rbar, jnp.minimum(j + 1, nt - 1), cidx, dtype)
+        v = jnp.where(do_right, v, jnp.zeros_like(v))
+        tp = jnp.where(do_right, tp, 0)
+        e = e.at[j].set(jnp.where(do_right, betar, 0).astype(rdtype))
+        # right update A <- A G with G = I - tp v v^H: x = tp * A_cur v
+        basex = _unwrap_vec(gemv(Atrail, _wrap_vec(v, g), orient="N",
+                                 precision=precision))
+        x = basex - U @ (jnp.conj(Y).T @ v) - X @ (jnp.conj(V).T @ v)
+        x = (tp * x).astype(dtype)
+        V = V.at[:, j].set(v)
+        X = X.at[:, j].set(x)
+        taup = taup.at[j].set(tp)
+        return U, Y, V, X, d, e, tauq, taup
+
+    init = (jnp.zeros((mt, nbw), dtype), jnp.zeros((nt, nbw), dtype),
+            jnp.zeros((nt, nbw), dtype), jnp.zeros((mt, nbw), dtype),
+            jnp.zeros((nbw,), rdtype), jnp.zeros((nbw,), rdtype),
+            jnp.zeros((nbw,), dtype), jnp.zeros((nbw,), dtype))
+    return lax.fori_loop(0, nbw, body, init)
+
+
+def bidiag(A: DistMatrix, nb: int | None = None, precision=None):
+    """Reduce a tall/square [MC,MR] matrix (m >= n) to upper bidiagonal
+    form ``A = Q B P^H`` (``El::Bidiag``, ``src/lapack_like/condense/
+    Bidiag/**``).
+
+    Returns ``(Ap, d, e, tauq, taup)``: ``d`` the diagonal, ``e`` the
+    superdiagonal (length n-1); left reflectors packed below the diagonal
+    of ``Ap`` (unit at row j -- geqrf layout, so :func:`.qr.apply_q`
+    applies Q); right reflector j's tail stored in ROW j at columns
+    >= j+2 (unit at column j+1), applied by :func:`apply_p_bidiag`."""
+    _check_mcmr(A)
+    m, n = A.gshape
+    if m < n:
+        raise ValueError("bidiag requires m >= n (transpose the input)")
+    g = A.grid
+    r, c = g.height, g.width
+    dtype = A.dtype
+    rdtype = _real_dtype(dtype)
+    if n == 0:
+        z = jnp.zeros((0,), rdtype)
+        return A, z, z, jnp.zeros((0,), dtype), jnp.zeros((0,), dtype)
+    grain = math.lcm(r, c)
+    ib = _blocksize(nb, grain, n)
+    Ap = A
+    d_parts, e_parts, tq_parts, tp_parts = [], [], [], []
+    for s in range(0, n, ib):
+        e_col = min(s + ib, n)
+        nbw = e_col - s
+        Atrail = view(Ap, rows=(s, m), cols=(s, n))
+        ce_up = min(round_up(e_col, c), n)
+        re_up = min(round_up(e_col, r), m)
+        Pc = redistribute(view(Ap, rows=(s, m), cols=(s, ce_up)),
+                          STAR, STAR).local[:, :nbw]
+        Pr = redistribute(view(Ap, rows=(s, re_up), cols=(s, n)),
+                          STAR, STAR).local[:nbw, :]
+        U, Y, V, X, dpan, epan, tq, tp = _bidiag_panel(Atrail, Pc, Pr, nbw,
+                                                       precision)
+        d_parts.append(dpan)
+        e_parts.append(epan)
+        tq_parts.append(tq)
+        tp_parts.append(tp)
+        # packed panel columns: u tails below diag, d on diag, e on superdiag
+        mt, nt = m - s, n - s
+        rl = jnp.arange(mt)[:, None]
+        cl = jnp.arange(nbw)[None, :]
+        packedc = jnp.where(rl > cl, U[:, :nbw], 0)
+        packedc = jnp.where(rl == cl, dpan[None, :nbw].astype(dtype)
+                            * jnp.ones((mt, 1), dtype), packedc)
+        esup = jnp.concatenate([jnp.zeros((1,), rdtype), epan[:nbw]])
+        packedc = jnp.where(rl == cl - 1,
+                            esup[None, jnp.arange(nbw)].astype(dtype)
+                            * jnp.ones((mt, 1), dtype), packedc)
+        # in-panel right-reflector tails: entry (i, jc) with i <= jc-2 holds
+        # v_i[jc] (row-stored packing restricted to the panel's columns)
+        VT = jnp.pad(V.T[:nbw, :nbw], ((0, max(mt - nbw, 0)), (0, 0)))[:mt, :]
+        packedc = jnp.where(rl + 2 <= cl, VT, packedc)
+        if ce_up > e_col:
+            packedc = jnp.pad(packedc, ((0, 0), (0, ce_up - e_col)))
+        blk = DistMatrix(packedc, (mt, ce_up - s), STAR, STAR, 0, 0, g)
+        Ap = _update_cols_lt(Ap, redistribute(blk, MC, MR), (s, m),
+                             (s, ce_up), e_col)
+        # packed panel rows: v tails right of superdiag, e on superdiag
+        rl2 = jnp.arange(nbw)[:, None]
+        cl2 = jnp.arange(nt)[None, :]
+        packedr = jnp.where(cl2 > rl2 + 1, V.T[:nbw, :], 0)
+        packedr = jnp.where(cl2 == rl2 + 1,
+                            epan[:nbw, None].astype(dtype)
+                            * jnp.ones((1, nt), dtype), packedr)
+        if re_up > e_col:
+            packedr = jnp.pad(packedr, ((0, re_up - e_col), (0, 0)))
+        blkr = DistMatrix(packedr, (re_up - s, nt), STAR, STAR, 0, 0, g)
+        cur = view(Ap, rows=(s, re_up), cols=(s, n))
+        I2, J2 = _global_indices(cur)
+        # rows < nbw, columns >= e_col only: the diag/superdiag and in-panel
+        # tails are owned by the column write above
+        keep = (I2 < nbw)[:, None] & (J2 >= (e_col - s))[None, :]
+        merged = jnp.where(keep, redistribute(blkr, MC, MR).local, cur.local)
+        Ap = update_view(Ap, cur.with_local(merged), rows=(s, re_up),
+                         cols=(s, n))
+        if e_col == n:
+            break
+        # trailing update: A22 -= U2 Y2^H + X2 V2^H
+        U2 = U[nbw:, :]
+        X2 = X[nbw:, :]
+        Y2 = Y[nbw:, :]
+        V2 = V[nbw:, :]
+        mt2, nt2 = m - e_col, n - e_col
+        U2mc = redistribute(DistMatrix(U2, (mt2, nbw), STAR, STAR, 0, 0, g),
+                            MC, STAR)
+        X2mc = redistribute(DistMatrix(X2, (mt2, nbw), STAR, STAR, 0, 0, g),
+                            MC, STAR)
+        Y2Hmr = redistribute(DistMatrix(jnp.conj(Y2).T, (nbw, nt2), STAR,
+                                        STAR, 0, 0, g), STAR, MR)
+        V2Hmr = redistribute(DistMatrix(jnp.conj(V2).T, (nbw, nt2), STAR,
+                                        STAR, 0, 0, g), STAR, MR)
+        A22 = view(Ap, rows=(e_col, m), cols=(e_col, n))
+        upd = (jnp.matmul(U2mc.local, Y2Hmr.local, precision=precision)
+               + jnp.matmul(X2mc.local, V2Hmr.local, precision=precision))
+        Ap = update_view(Ap, A22.with_local(A22.local - upd.astype(dtype)),
+                         rows=(e_col, m), cols=(e_col, n))
+    d = jnp.concatenate(d_parts)[:n]
+    e_ = jnp.concatenate(e_parts)[:n - 1] if n > 1 else jnp.zeros((0,), rdtype)
+    tauq = jnp.concatenate(tq_parts)[:n]
+    taup = jnp.concatenate(tp_parts)[:max(n - 1, 0)]
+    return Ap, d, e_, tauq, taup
+
+
+def apply_p_bidiag(Ap: DistMatrix, taup, B: DistMatrix, orient: str = "N",
+                   nb: int | None = None, precision=None) -> DistMatrix:
+    """B := P B ('N') or P^H B ('C') with P = G_0 G_1 ... G_{n-2} the
+    right-reflector product from :func:`bidiag` (G_j = I - taup_j
+    v_j v_j^H, v_j unit at position j+1)."""
+    _check_mcmr(Ap, B)
+    n = Ap.gshape[1]
+    if B.gshape[0] != n:
+        raise ValueError(f"B height {B.gshape[0]} != {n}")
+    g = Ap.grid
+    r, c = g.height, g.width
+    ib = _blocksize(nb, math.lcm(r, c), n)
+    kend = max(n - 1, 0)
+    starts = list(range(0, kend, ib))
+    if orient == "N":
+        starts = starts[::-1]
+    for s in starts:
+        e_col = min(s + ib, kend)
+        nbw = e_col - s
+        re_up = min(round_up(e_col, r), Ap.gshape[0])
+        Prow = redistribute(view(Ap, rows=(s, re_up), cols=(s, n)),
+                            STAR, STAR).local[:nbw, :]
+        # V panel: v_j tails from row j at cols >= j+2 (unit at j+1)
+        nt = n - s
+        rl = jnp.arange(nt)[:, None]
+        cl = jnp.arange(nbw)[None, :]
+        V = jnp.where(rl >= cl + 2, Prow.T[:nt, :nbw], 0)
+        V = V + jnp.eye(nt, nbw, k=-1, dtype=Prow.dtype)
+        T = _larft(V, taup[s:e_col])
+        Tm = jnp.conj(T).T if orient == "C" else T
+        V_mc = redistribute(
+            DistMatrix(V, (nt, nbw), STAR, STAR, 0, 0, g), MC, STAR)
         B2 = view(B, rows=(s, n))
         Wl = jnp.matmul(jnp.conj(V_mc.local).T, B2.local, precision=precision)
         Wl = jnp.matmul(Tm, Wl, precision=precision)

@@ -61,7 +61,14 @@ def _complex_dtype(dtype):
 def _replicated_schur(A: DistMatrix):
     """Base case: gather + sequential complex QR algorithm, run on host
     (the reference's redundant-hseqr fallback)."""
-    import scipy.linalg
+    try:
+        import scipy.linalg
+    except ImportError as exc:                      # pragma: no cover
+        raise ImportError(
+            "schur/eig/pseudospectra need scipy for the sequential "
+            "QR-algorithm base case (the reference's hseqr analog); "
+            "install scipy or raise `base` is not an option -- every "
+            "recursion bottoms out here") from exc
     n = A.gshape[0]
     Ag = np.asarray(to_global(A))
     T, Q = scipy.linalg.schur(Ag, output="complex")
@@ -101,8 +108,10 @@ def _sdc(A: DistMatrix, base: int, nb, precision, seed: int, depth: int = 0):
         except FloatingPointError:
             continue
         P = shift_diagonal(S.with_local(-0.5 * S.local), 0.5)
-        k = int(round(float(jnp.real(
-            jnp.sum(jnp.where(_diag_mask(P), P.local, 0))))))
+        kf = float(jnp.real(jnp.sum(jnp.where(_diag_mask(P), P.local, 0))))
+        if not math.isfinite(kf):
+            continue        # sign silently filled with NaN/Inf: next line
+        k = int(round(kf))
         if not (0 < k < n):
             continue
         G = rng.normal(size=(n, k)) + 1j * rng.normal(size=(n, k))
@@ -145,6 +154,15 @@ def _sdc(A: DistMatrix, base: int, nb, precision, seed: int, depth: int = 0):
 def _diag_mask(A: DistMatrix):
     I, J = _global_indices(A)
     return (J[None, :] == I[:, None]) & (I[:, None] < A.gshape[0])
+
+
+def _global_colnorms(X: DistMatrix, k: int):
+    """Column 2-norms in GLOBAL order from the storage array.  Out-of-range
+    (padding) storage columns are DROPPED -- clipping first would clobber
+    column k-1."""
+    ns = jnp.sqrt(jnp.sum(jnp.abs(X.local) ** 2, axis=0))
+    _, J = _global_indices(X)
+    return jnp.zeros((k,), ns.dtype).at[J].set(ns, mode="drop")
 
 
 def schur(A: DistMatrix, base: int | None = None, nb: int | None = None,
@@ -194,12 +212,8 @@ def triang_eig(T: DistMatrix, nb: int | None = None, precision=None):
     B = shift_diagonal(_blank(n, n, T), 1)
     X = multishift_trsm("U", "N", T, w, B, nb=nb, precision=precision,
                         diag_hook=hook)
-    # normalize columns to unit 2-norm (storage col sums -> global order)
-    norms_stor = jnp.sqrt(jnp.sum(jnp.abs(X.local) ** 2, axis=0))
-    _, J = _global_indices(X)
-    # out-of-range (padding) positions are DROPPED -- do not clip first
-    norms = jnp.zeros((n,), norms_stor.dtype).at[J].set(norms_stor,
-                                                        mode="drop")
+    # normalize columns to unit 2-norm
+    norms = _global_colnorms(X, n)
     inv = jnp.where(norms > 0, 1.0 / jnp.where(norms == 0, 1, norms), 0)
     dinv = DistMatrix(inv[:, None].astype(X.dtype), (n, 1), STAR, STAR, 0, 0, g)
     return w, diagonal_scale("R", dinv, X)
@@ -245,10 +259,7 @@ def pseudospectra(A: DistMatrix, re_window, im_window, nx: int = 20,
     V = from_global(V0.astype(np.dtype(T.dtype)), MC, MR, grid=g)
 
     def colnorms(X):
-        ns = jnp.sqrt(jnp.sum(jnp.abs(X.local) ** 2, axis=0))
-        _, J = _global_indices(X)
-        # padding positions dropped (no clip -- it would clobber col k-1)
-        return jnp.zeros((k,), ns.dtype).at[J].set(ns, mode="drop")
+        return _global_colnorms(X, k)
 
     cshifts = jnp.conj(shifts)     # (T - z)^H = T^H - conj(z) I
     est = None

@@ -201,6 +201,8 @@ def svd(A: DistMatrix, vectors: bool = True, approach: str = "auto",
         U = Q U_R (the reference's default for m >= 1.5 n).
       * 'polar' -- QDWH polar + Hermitian eigensolve of the factor H
         (matmul-rich, fully distributed; the TPU-paper recipe).
+      * 'golub' -- Bidiag + tridiagonal EVP of B^H B + back-transform
+        (``svd::GolubReinsch`` analog; see :func:`_svd_golub_kahan`).
       * 'auto'  -- 'chan' when m >= 1.5 n (or the mirrored transpose when
         n >= 1.5 m), else 'polar'.
     ``eig_approach`` is forwarded to the inner :func:`herm_eig` ('qdwh'
@@ -233,6 +235,9 @@ def svd(A: DistMatrix, vectors: bool = True, approach: str = "auto",
         U = apply_q(Ap, tau, U0, orient="N", nb=nb, precision=precision)
         return U, s, V
 
+    if approach == "golub":
+        return _svd_golub_kahan(A, vectors, nb, precision, eig_approach)
+
     if approach == "local" or (approach in ("chan",) and m == n):
         approach = "local"
     if approach == "local":
@@ -246,6 +251,76 @@ def svd(A: DistMatrix, vectors: bool = True, approach: str = "auto",
                           MC, MR)
         return Ud, s.astype(_real_dtype(A.dtype)), Vd
 
+    if approach == "polar":
+        return _svd_polar(A, vectors, nb, precision, eig_approach)
+    raise ValueError(f"unknown svd approach {approach!r}")
+
+
+def _svd_golub_kahan(A: DistMatrix, vectors: bool, nb, precision,
+                     eig_approach: str):
+    """Golub-Kahan path (``svd::GolubReinsch`` analog): Bidiag, then the
+    symmetric tridiagonal EVP of B^H B (with eig_approach='qdwh' this is the
+    fully-scalable spectral D&C -- no replicated O(n^2) construct), then
+    back-transform U = Q [B V_B S^{-1}; 0], V = P V_B.
+
+    Numerical note: forming B^H B squares the condition number; singular
+    values below ~sqrt(eps)*s_max lose relative accuracy (the price of the
+    bidiagonal-free tridiagonal solve; use 'polar' when they matter).
+    """
+    from ..core.view import pad_matrix
+    from ..redist.interior import interior_view
+    from ..blas.level1 import index_dependent_fill
+    from ..core.distmatrix import zeros as dm_zeros
+    from .condense import bidiag, apply_p_bidiag
+    from .lu import permute_cols
+    m, n = A.gshape
+    g = A.grid
+    rdtype = _real_dtype(A.dtype)
+    Ap, d, e, tauq, taup = bidiag(A, nb=nb, precision=precision)
+    epad = jnp.concatenate([jnp.zeros((1,), rdtype), e])      # e_{j-1} at j
+    enext = jnp.concatenate([e, jnp.zeros((1,), rdtype)])     # e_j at j
+    T0 = dm_zeros(n, n, MC, MR, g, dtype=rdtype)
+
+    def tfill(i, j):
+        ic = jnp.clip(i, 0, n - 1)
+        jc = jnp.clip(j, 0, n - 1)
+        diag = d[ic] ** 2 + epad[ic] ** 2
+        # (B^H B)[i, i+1] = d_i e_i ; [i+1, i] its conjugate (real here)
+        sup = d[ic] * jnp.take(e, jnp.clip(i, 0, max(n - 2, 0)))
+        sub = d[jc] * jnp.take(e, jnp.clip(j, 0, max(n - 2, 0)))
+        return jnp.where(i == j, diag,
+                         jnp.where(j == i + 1, sup,
+                                   jnp.where(i == j + 1, sub, 0.0)))
+
+    T = index_dependent_fill(T0, tfill)
+    out = herm_eig(T, "L", vectors, nb=nb, approach=eig_approach,
+                   precision=precision)
+    if not vectors:
+        w = out
+        return jnp.sqrt(jnp.clip(jnp.sort(w)[::-1], 0, None))
+    w, Z = out
+    order = jnp.argsort(-w)
+    s = jnp.sqrt(jnp.clip(w[order], 0, None))
+    # cast to A's dtype BEFORE the complex back-transforms (a real-typed VB
+    # would silently truncate the reflectors' imaginary parts)
+    VB = permute_cols(Z, order).astype(A.dtype)
+    # U_B = B V_B S^{-1}: row i of B V_B = d_i VB[i,:] + e_i VB[i+1,:]
+    dd = DistMatrix(d[:, None].astype(A.dtype), (n, 1), STAR, STAR, 0, 0, g)
+    ee = DistMatrix(enext[:, None].astype(A.dtype), (n, 1), STAR, STAR, 0, 0, g)
+    VBshift = pad_matrix(interior_view(VB, (1, n), (0, n)), n, n)
+    BV = diagonal_scale("L", dd, VB)
+    BV = BV.with_local(BV.local + diagonal_scale("L", ee, VBshift).local)
+    sinv = jnp.where(s > 0, 1.0 / jnp.where(s == 0, 1.0, s), 0)
+    ds = DistMatrix(sinv[:, None].astype(A.dtype), (n, 1), STAR, STAR, 0, 0, g)
+    UB = diagonal_scale("R", ds, BV)
+    V = apply_p_bidiag(Ap, taup, VB, orient="N", nb=nb, precision=precision)
+    U = apply_q(Ap, tauq, pad_matrix(UB, m, n), orient="N", nb=nb,
+                precision=precision)
+    return U, s, V
+
+
+def _svd_polar(A: DistMatrix, vectors: bool, nb, precision,
+               eig_approach: str):
     # polar path: A = Up H; H = V diag(w) V^H; s = w desc; U = Up V
     from .funcs import polar
     Up, H = polar(A, nb=nb, precision=precision)

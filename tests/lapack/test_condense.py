@@ -76,3 +76,64 @@ def test_hessenberg(grid24, dtype):
     orth = np.linalg.norm(np.eye(n) - Qg.conj().T @ Qg)
     assert resid < 1e-12
     assert orth < 1e-12
+
+
+# ---------------------------------------------------------------------
+# Bidiag (the SVD condense step)
+# ---------------------------------------------------------------------
+
+def _check_bidiag(F, grid, nb):
+    import elemental_tpu as el
+    from elemental_tpu.lapack.condense import bidiag, apply_p_bidiag
+    from elemental_tpu.lapack.qr import apply_q
+    m, n = F.shape
+    A = el.from_global(F, el.MC, el.MR, grid=grid)
+    Ap, d, e, tauq, taup = bidiag(A, nb=nb)
+    dn, en = np.asarray(d), np.asarray(e)
+    assert np.isrealobj(dn) and np.isrealobj(en)
+    B = np.zeros((m, n), F.dtype)
+    B[:n, :n] = np.diag(dn.astype(F.dtype)) + np.diag(en.astype(F.dtype), 1)
+    I_m = el.from_global(np.eye(m, dtype=F.dtype), el.MC, el.MR, grid=grid)
+    I_n = el.from_global(np.eye(n, dtype=F.dtype), el.MC, el.MR, grid=grid)
+    Q = np.asarray(el.to_global(apply_q(Ap, tauq, I_m, orient="N")))
+    P = np.asarray(el.to_global(apply_p_bidiag(Ap, taup, I_n, orient="N")))
+    assert np.linalg.norm(Q.conj().T @ Q - np.eye(m)) < 1e-12 * m
+    assert np.linalg.norm(P.conj().T @ P - np.eye(n)) < 1e-12 * n
+    rec = Q @ B @ P.conj().T
+    assert np.linalg.norm(rec - F) / np.linalg.norm(F) < 1e-13
+    sa = np.linalg.svd(F, compute_uv=False)
+    sb = np.linalg.svd(B, compute_uv=False)
+    assert np.linalg.norm(sa - sb) < 1e-12 * max(sa[0], 1)
+
+
+def test_bidiag_tall(grid24):
+    rng = np.random.default_rng(20)
+    _check_bidiag(rng.normal(size=(24, 16)), grid24, nb=8)
+
+
+def test_bidiag_square_full_panel(grid24):
+    rng = np.random.default_rng(21)
+    _check_bidiag(rng.normal(size=(16, 16)), grid24, nb=16)
+
+
+def test_bidiag_complex(grid24):
+    rng = np.random.default_rng(22)
+    F = rng.normal(size=(20, 12)) + 1j * rng.normal(size=(20, 12))
+    _check_bidiag(F, grid24, nb=4)
+
+
+def test_svd_golub_kahan(grid24):
+    import elemental_tpu as el
+    rng = np.random.default_rng(23)
+    F = rng.normal(size=(32, 20))
+    A = el.from_global(F, el.MC, el.MR, grid=grid24)
+    U, s, V = el.svd(A, approach="golub")
+    rec = np.asarray(el.to_global(U)) @ np.diag(np.asarray(s)) \
+        @ np.asarray(el.to_global(V)).T
+    assert np.linalg.norm(rec - F) / np.linalg.norm(F) < 1e-13
+    assert np.allclose(np.asarray(s), np.linalg.svd(F, compute_uv=False),
+                       atol=1e-12)
+    # values-only + the scalable eig path
+    s2 = el.svd(A, vectors=False, approach="golub", eig_approach="qdwh")
+    assert np.allclose(np.asarray(s2), np.linalg.svd(F, compute_uv=False),
+                       atol=1e-10)
