@@ -30,3 +30,7 @@ from .optimization import (MehrotraCtrl, lp, qp, soft_threshold, svt,
                            bp, lav, nnls, lasso, svm, rpca)
 from .control import sylvester, lyapunov, riccati
 from .lapack.schur import schur, triang_eig, eig, pseudospectra
+from .lapack.props import (determinant, safe_determinant, hpd_determinant,
+                           two_norm_estimate, condition, nuclear_norm,
+                           schatten_norm, two_norm)
+from .io import print_matrix, write_matrix, read_matrix, checkpoint, restore

@@ -1,0 +1,5 @@
+"""IO layer (SURVEY.md §3.5): print/write/read/checkpoint.
+
+Reference: Elemental ``src/io/``.
+"""
+from .core import print_matrix, write_matrix, read_matrix, checkpoint, restore

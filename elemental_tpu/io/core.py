@@ -1,0 +1,96 @@
+"""IO: print, write, read, checkpoint.
+
+Reference: Elemental ``src/io/`` -- ``Print.cpp`` (``El::Print``),
+``Write.cpp``/``Read.cpp`` (ASCII / BINARY / BINARY_FLAT / MATRIX_MARKET
+formats), with distributed IO funneled through a ``[CIRC,CIRC]`` gather.
+
+TPU-native shape: ``to_global`` is the ``[CIRC,CIRC]`` analog (the storage
+array's index-permutation inverse); the ``"shards"`` format instead dumps
+the stacked-storage array as-is plus its layout metadata -- the
+BINARY_FLAT / per-rank-files analog, reloadable into the SAME grid shape
+without ever forming the global matrix on one host (at multi-host scale an
+orbax-style async checkpointer slots in here).
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dist import Dist, MC, MR
+from ..core.distmatrix import DistMatrix, from_global, to_global
+from ..core.grid import Grid, default_grid
+
+
+def print_matrix(A: DistMatrix, title: str = "", stream=None,
+                 precision: int = 6):
+    """Formatted print of the global matrix (``El::Print``; gathers through
+    the [CIRC,CIRC]-analog bridge)."""
+    import sys
+    stream = stream or sys.stdout
+    arr = np.asarray(to_global(A))
+    if title:
+        stream.write(f"{title}\n")
+    with np.printoptions(precision=precision, suppress=False,
+                         linewidth=120, threshold=10000):
+        stream.write(f"{arr}\n")
+
+
+def write_matrix(A: DistMatrix, path: str, format: str = "npy") -> None:
+    """Write a DistMatrix (``El::Write``).
+
+    ``format``:
+      * 'npy'    -- the GLOBAL matrix as a standard .npy (BINARY analog;
+        gathers to host -- interoperable, not for at-scale operands).
+      * 'shards' -- the stacked-storage array + layout metadata as
+        ``<path>.npz`` (BINARY_FLAT analog; no global gather, reload
+        requires an identical grid shape).
+    """
+    if format == "npy":
+        np.save(path, np.asarray(to_global(A)))
+        return
+    if format == "shards":
+        meta = dict(gshape=list(A.gshape), cdist=A.cdist.value,
+                    rdist=A.rdist.value, calign=A.calign, ralign=A.ralign,
+                    grid=[A.grid.height, A.grid.width])
+        np.savez(path, storage=np.asarray(A.local),
+                 meta=json.dumps(meta))
+        return
+    raise ValueError(f"unknown format {format!r}")
+
+
+def read_matrix(path: str, cdist: Dist = MC, rdist: Dist = MR,
+                grid: Grid | None = None) -> DistMatrix:
+    """Read a matrix written by :func:`write_matrix` (``El::Read``)."""
+    grid = grid or default_grid()
+    if path.endswith(".npz") or os.path.exists(path + ".npz"):
+        p = path if path.endswith(".npz") else path + ".npz"
+        data = np.load(p, allow_pickle=False)
+        meta = json.loads(str(data["meta"]))
+        if meta["grid"] != [grid.height, grid.width]:
+            raise ValueError(
+                f"shard checkpoint was written on a {meta['grid']} grid; "
+                f"reload on {[grid.height, grid.width]} requires the global "
+                "'npy' format (cross-grid TranslateBetweenGrids analog)")
+        d = Dist(meta["cdist"]), Dist(meta["rdist"])
+        return DistMatrix(jnp.asarray(data["storage"]),
+                          tuple(meta["gshape"]), d[0], d[1],
+                          meta["calign"], meta["ralign"], grid)
+    p = path if path.endswith(".npy") else path + ".npy"
+    return from_global(np.load(p), cdist, rdist, grid=grid)
+
+
+def checkpoint(path: str, **named: DistMatrix) -> None:
+    """Write a named set of DistMatrices as shard files under ``path``
+    (SURVEY.md §6.4 checkpoint/resume building block)."""
+    os.makedirs(path, exist_ok=True)
+    for name, A in named.items():
+        write_matrix(A, os.path.join(path, name), format="shards")
+
+
+def restore(path: str, names, grid: Grid | None = None) -> dict:
+    """Reload a :func:`checkpoint` directory; returns {name: DistMatrix}."""
+    return {name: read_matrix(os.path.join(path, name), grid=grid)
+            for name in names}

@@ -12,3 +12,6 @@ from .funcs import (polar, sign, inverse, triangular_inverse, hpd_inverse,
 from .spectral import (herm_eig, skew_herm_eig, herm_gen_def_eig,
                        hermitian_svd, svd)
 from .schur import schur, triang_eig, eig, pseudospectra
+from .props import (determinant, safe_determinant, hpd_determinant,
+                    two_norm_estimate, condition, inertia as matrix_inertia,
+                    nuclear_norm, schatten_norm, two_norm)

@@ -1,0 +1,161 @@
+"""Matrix properties: determinant, condition, inertia, norm estimates.
+
+Reference: Elemental ``src/lapack_like/props/**`` -- ``Determinant.cpp``
+(``El::Determinant``, ``SafeDeterminant`` via LU with pivot-sign),
+``Condition.cpp`` (one/two/frobenius/infinity), ``Inertia.cpp`` (via
+pivoted LDL), ``TwoNormEstimate.cpp`` (power iteration), ``Norm``
+implementations (level-1 storage reductions live in
+:mod:`..blas.level1`; the Schatten family is added here via the SVD).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.distmatrix import DistMatrix
+from ..core.dist import MC, MR
+from ..blas.level1 import (frobenius_norm, one_norm, infinity_norm,
+                           get_diagonal)
+from ..blas.level2 import gemv
+from ..blas.level3 import _check_mcmr
+from .lu import lu
+from .cholesky import cholesky
+from .ldl import ldl, inertia as _ldl_inertia
+from .funcs import inverse
+
+
+def _perm_sign(perm) -> float:
+    """Parity of a permutation vector (host-side cycle count)."""
+    p = np.asarray(perm)
+    n = p.shape[0]
+    seen = np.zeros(n, bool)
+    sign = 1.0
+    for i in range(n):
+        if seen[i]:
+            continue
+        j = i
+        clen = 0
+        while not seen[j]:
+            seen[j] = True
+            j = int(p[j])
+            clen += 1
+        if clen % 2 == 0:
+            sign = -sign
+    return sign
+
+
+def determinant(A: DistMatrix, nb: int | None = None, precision=None):
+    """det(A) via LU with partial pivoting (``El::Determinant``)."""
+    _check_mcmr(A)
+    n = A.gshape[0]
+    if A.gshape != (n, n):
+        raise ValueError(f"determinant needs square, got {A.gshape}")
+    if n == 0:
+        return jnp.ones((), A.dtype)
+    LU_, perm = lu(A, nb=nb, precision=precision)
+    diag = get_diagonal(LU_).local[:, 0]
+    return jnp.prod(diag) * _perm_sign(perm)
+
+
+def safe_determinant(A: DistMatrix, nb: int | None = None, precision=None):
+    """(rho, kappa, n) with det = rho * exp(kappa * n): unit-modulus rho and
+    a log-scaled magnitude (``El::SafeDeterminant`` -- overflow-proof)."""
+    _check_mcmr(A)
+    n = A.gshape[0]
+    if A.gshape != (n, n):
+        raise ValueError(f"safe_determinant needs square, got {A.gshape}")
+    if n == 0:
+        return jnp.ones((), A.dtype), jnp.zeros(()), 0
+    LU_, perm = lu(A, nb=nb, precision=precision)
+    diag = get_diagonal(LU_).local[:, 0]
+    mags = jnp.abs(diag)
+    safe = jnp.where(mags == 0, 1.0, mags)
+    rho = jnp.prod(jnp.where(mags == 0, 0.0, diag / safe)) * _perm_sign(perm)
+    kappa = jnp.sum(jnp.log(safe)) / n
+    kappa = jnp.where(jnp.any(mags == 0), -jnp.inf, kappa)
+    return rho, kappa, n
+
+
+def hpd_determinant(A: DistMatrix, uplo: str = "L", nb: int | None = None,
+                    precision=None):
+    """det of an HPD matrix via Cholesky: prod(diag(L))^2
+    (``El::HPDDeterminant``)."""
+    L = cholesky(A, uplo, nb=nb, precision=precision)
+    diag = jnp.real(get_diagonal(L).local[:, 0])
+    return jnp.prod(diag) ** 2
+
+
+def two_norm_estimate(A: DistMatrix, iters: int = 20, seed: int = 0,
+                      precision=None):
+    """Power-iteration estimate of ||A||_2 (``El::TwoNormEstimate``)."""
+    _check_mcmr(A)
+    m, n = A.gshape
+    from ..core.distmatrix import from_global
+    rng = np.random.default_rng(seed)
+    x = from_global(rng.normal(size=(n, 1)).astype(np.dtype(A.dtype))
+                    if not jnp.issubdtype(A.dtype, jnp.complexfloating)
+                    else (rng.normal(size=(n, 1))
+                          + 1j * rng.normal(size=(n, 1))).astype(
+                              np.dtype(A.dtype)),
+                    MC, MR, grid=A.grid)
+    nx0 = frobenius_norm(x)
+    x = x.with_local(x.local / jnp.maximum(nx0, 1e-300))
+    est = jnp.zeros((), jnp.zeros((), A.dtype).real.dtype)
+    for _ in range(iters):
+        # one step of power iteration on A^H A: est -> sigma_max^2
+        y = gemv(A, x, precision=precision)
+        z = gemv(A, y, orient="C", precision=precision)
+        est = frobenius_norm(z)
+        x = z.with_local(z.local / jnp.maximum(est, 1e-300))
+    return jnp.sqrt(est)
+
+
+def condition(A: DistMatrix, p: str = "two", nb: int | None = None,
+              precision=None):
+    """Condition number in the given norm (``El::Condition``)."""
+    _check_mcmr(A)
+    p = p.lower()
+    if p in ("two", "2"):
+        from .spectral import svd
+        s = svd(A, vectors=False, nb=nb, precision=precision)
+        smin = s[-1]
+        return jnp.where(smin > 0, s[0] / jnp.where(smin == 0, 1, smin),
+                         jnp.inf)
+    Ai = inverse(A, nb=nb, precision=precision)
+    if p in ("one", "1"):
+        return one_norm(A) * one_norm(Ai)
+    if p in ("inf", "infinity"):
+        return infinity_norm(A) * infinity_norm(Ai)
+    if p in ("frob", "frobenius"):
+        return frobenius_norm(A) * frobenius_norm(Ai)
+    raise ValueError(f"unknown norm {p!r}")
+
+
+def inertia(A: DistMatrix, uplo: str = "L", nb: int | None = None,
+            precision=None):
+    """(n+, n-, n0) eigenvalue-sign counts of a Hermitian matrix via pivoted
+    LDL + Sylvester's law (``El::Inertia``)."""
+    _, d, e, _ = ldl(A, uplo, nb=nb, precision=precision)
+    return _ldl_inertia(d, e)
+
+
+def nuclear_norm(A: DistMatrix, nb: int | None = None, precision=None):
+    """Sum of singular values (``El::NuclearNorm``)."""
+    from .spectral import svd
+    s = svd(A, vectors=False, nb=nb, precision=precision)
+    return jnp.sum(s)
+
+
+def schatten_norm(A: DistMatrix, p: float, nb: int | None = None,
+                  precision=None):
+    """(sum s_i^p)^(1/p) (``El::SchattenNorm``)."""
+    from .spectral import svd
+    s = svd(A, vectors=False, nb=nb, precision=precision)
+    return jnp.sum(s ** p) ** (1.0 / p)
+
+
+def two_norm(A: DistMatrix, nb: int | None = None, precision=None):
+    """Largest singular value (``El::TwoNorm``)."""
+    from .spectral import svd
+    s = svd(A, vectors=False, nb=nb, precision=precision)
+    return s[0]

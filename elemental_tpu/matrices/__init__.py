@@ -3,3 +3,10 @@ from .basic import (
     zeros, ones, identity, hilbert, lehmer, minij,
     uniform, gaussian, hermitian_uniform_spectrum,
 )
+from .gallery import (
+    fourier, toeplitz, hankel, circulant, cauchy, walsh, wilkinson,
+    laplacian_1d, laplacian_2d, jordan, kahan, grcar, parter, pei,
+    redheffer, triw, gear, gepp_growth,
+    gaussian_device, uniform_device, bernoulli, rademacher, wigner, haar,
+    normal_uniform_spectrum,
+)

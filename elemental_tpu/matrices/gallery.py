@@ -1,0 +1,382 @@
+"""Matrix gallery breadth: deterministic generators + device-side RNG.
+
+Reference: Elemental ``src/matrices/**`` (~60 deterministic + random
+generators, the test/benchmark input factory): ``Fourier``, ``Toeplitz``,
+``Hankel``, ``Circulant``, ``Cauchy``, ``Walsh``, ``Wilkinson``,
+``Laplacian``, ``Jordan``, ``Kahan``, ``Grcar``, ``Parter``, ``Pei``,
+``Redheffer``, ``TriW``, ``Gear``, ``GEPPGrowth``, ``Wigner``, ``Haar``,
+``Bernoulli``, ``Rademacher``, ``NormalUniformSpectrum``...
+
+Deterministic generators ride :func:`..blas.level1.index_dependent_fill`
+(device-side, layout-independent).  Random generators come in two flavors:
+the host path (:mod:`.basic`) is layout-INdependent; the device-side path
+here seeds a per-device stream by mesh rank inside ``shard_map`` -- exactly
+the reference's "per-process PRNGs with rank-dependent seeding" (SURVEY.md
+§3.5 Random), so results depend on the grid shape but never leave device
+memory (the at-scale requirement).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.dist import MC, MR
+from ..core.distmatrix import DistMatrix, from_global
+from ..core.grid import Grid, default_grid
+from ..blas.level1 import index_dependent_fill, shift_diagonal
+from .basic import _empty, gaussian as _host_gaussian
+
+
+# ---------------------------------------------------------------------
+# deterministic generators (device-side, layout-independent)
+# ---------------------------------------------------------------------
+
+def fourier(n: int, grid: Grid | None = None, dtype=jnp.complex128):
+    """Unitary DFT matrix (``El::Fourier``): F[i,j] = e^{-2 pi i ij/n}/sqrt(n)."""
+    A = _empty(n, n, grid or default_grid(), dtype)
+    w = -2.0 * math.pi / n
+    return index_dependent_fill(
+        A, lambda i, j: (jnp.exp(1j * w * (i * j)) / math.sqrt(n)).astype(dtype))
+
+
+def toeplitz(col, row, grid: Grid | None = None, dtype=None):
+    """Toeplitz from first column ``col`` and first row ``row``
+    (``El::Toeplitz``; row[0] ignored in favor of col[0])."""
+    col = jnp.asarray(col)
+    row = jnp.asarray(row)
+    m, n = col.shape[0], row.shape[0]
+    dtype = dtype or jnp.result_type(col.dtype, row.dtype)
+    A = _empty(m, n, grid or default_grid(), dtype)
+    ext = jnp.concatenate([row[1:][::-1], col]).astype(dtype)
+
+    def f(i, j):
+        return jnp.take(ext, jnp.clip(i - j + n - 1, 0, m + n - 2))
+
+    return index_dependent_fill(A, f)
+
+
+def hankel(col, row, grid: Grid | None = None, dtype=None):
+    """Hankel from first column and last row (``El::Hankel``)."""
+    col = jnp.asarray(col)
+    row = jnp.asarray(row)
+    m, n = col.shape[0], row.shape[0]
+    dtype = dtype or jnp.result_type(col.dtype, row.dtype)
+    A = _empty(m, n, grid or default_grid(), dtype)
+    ext = jnp.concatenate([col, row[1:]]).astype(dtype)
+
+    def f(i, j):
+        return jnp.take(ext, jnp.clip(i + j, 0, m + n - 2))
+
+    return index_dependent_fill(A, f)
+
+
+def circulant(c, grid: Grid | None = None, dtype=None):
+    """Circulant with first column ``c`` (``El::Circulant``)."""
+    c = jnp.asarray(c)
+    n = c.shape[0]
+    dtype = dtype or c.dtype
+    A = _empty(n, n, grid or default_grid(), dtype)
+    return index_dependent_fill(
+        A, lambda i, j: jnp.take(c.astype(dtype), (i - j) % n))
+
+
+def cauchy(x, y, grid: Grid | None = None, dtype=jnp.float64):
+    """C[i,j] = 1/(x_i - y_j) (``El::Cauchy``)."""
+    x = jnp.asarray(x, dtype)
+    y = jnp.asarray(y, dtype)
+    A = _empty(x.shape[0], y.shape[0], grid or default_grid(), dtype)
+    return index_dependent_fill(
+        A, lambda i, j: 1.0 / (jnp.take(x, i) - jnp.take(y, j)))
+
+
+def walsh(k: int, binary: bool = False, grid: Grid | None = None,
+          dtype=jnp.float64):
+    """2^k x 2^k Walsh matrix (``El::Walsh``): (-1)^{popcount(i & j)}
+    (or 0/1 when ``binary``)."""
+    n = 1 << k
+    A = _empty(n, n, grid or default_grid(), dtype)
+
+    def f(i, j):
+        bits = i & j
+        pc = jnp.zeros_like(bits)
+        for b in range(k):
+            pc = pc + ((bits >> b) & 1)
+        val = 1.0 - 2.0 * (pc % 2)
+        return ((val + 1) / 2 if binary else val).astype(dtype)
+
+    return index_dependent_fill(A, f)
+
+
+def wilkinson(k: int, grid: Grid | None = None, dtype=jnp.float64):
+    """(2k+1) x (2k+1) Wilkinson eigenvalue test matrix (``El::Wilkinson``)."""
+    n = 2 * k + 1
+    A = _empty(n, n, grid or default_grid(), dtype)
+
+    def f(i, j):
+        diag = jnp.abs(i - k).astype(dtype)
+        off = ((j == i + 1) | (i == j + 1)).astype(dtype)
+        return jnp.where(i == j, diag, off)
+
+    return index_dependent_fill(A, f)
+
+
+def laplacian_1d(n: int, grid: Grid | None = None, dtype=jnp.float64):
+    """Tridiagonal [-1, 2, -1] (``El::Laplacian`` 1-D)."""
+    A = _empty(n, n, grid or default_grid(), dtype)
+
+    def f(i, j):
+        return jnp.where(i == j, jnp.asarray(2, dtype),
+                         jnp.where(jnp.abs(i - j) == 1,
+                                   jnp.asarray(-1, dtype),
+                                   jnp.asarray(0, dtype)))
+
+    return index_dependent_fill(A, f)
+
+
+def laplacian_2d(nx: int, ny: int, grid: Grid | None = None,
+                 dtype=jnp.float64):
+    """5-point 2-D grid Laplacian of size (nx*ny)^2 (``El::Laplacian``)."""
+    n = nx * ny
+    A = _empty(n, n, grid or default_grid(), dtype)
+
+    def f(i, j):
+        xi, yi = i % nx, i // nx
+        xj, yj = j % nx, j // nx
+        same = (i == j)
+        horiz = (yi == yj) & (jnp.abs(xi - xj) == 1)
+        vert = (xi == xj) & (jnp.abs(yi - yj) == 1)
+        return jnp.where(same, jnp.asarray(4, dtype),
+                         jnp.where(horiz | vert, jnp.asarray(-1, dtype),
+                                   jnp.asarray(0, dtype)))
+
+    return index_dependent_fill(A, f)
+
+
+def jordan(n: int, lam, grid: Grid | None = None, dtype=jnp.float64):
+    """Jordan block with eigenvalue ``lam`` (``El::Jordan``)."""
+    A = _empty(n, n, grid or default_grid(), dtype)
+    A = shift_diagonal(A, lam, 0)
+    return shift_diagonal(A, 1, 1)
+
+
+def kahan(n: int, phi: float = 0.5, grid: Grid | None = None,
+          dtype=jnp.float64):
+    """Kahan's ill-conditioned triangular matrix (``El::Kahan``)."""
+    zeta = math.sqrt(1.0 - phi * phi)
+    A = _empty(n, n, grid or default_grid(), dtype)
+
+    def f(i, j):
+        zi = jnp.asarray(zeta, dtype) ** i.astype(dtype)
+        return jnp.where(i == j, zi,
+                         jnp.where(j > i, -phi * zi, jnp.asarray(0, dtype)))
+
+    return index_dependent_fill(A, f)
+
+
+def grcar(n: int, k: int = 3, grid: Grid | None = None, dtype=jnp.float64):
+    """Grcar nonnormal test matrix (``El::Grcar``)."""
+    A = _empty(n, n, grid or default_grid(), dtype)
+
+    def f(i, j):
+        return jnp.where(i == j + 1, jnp.asarray(-1, dtype),
+                         jnp.where((j >= i) & (j <= i + k),
+                                   jnp.asarray(1, dtype),
+                                   jnp.asarray(0, dtype)))
+
+    return index_dependent_fill(A, f)
+
+
+def parter(n: int, grid: Grid | None = None, dtype=jnp.float64):
+    """P[i,j] = 1/(i - j + 1/2) (``El::Parter``)."""
+    A = _empty(n, n, grid or default_grid(), dtype)
+    return index_dependent_fill(
+        A, lambda i, j: (1.0 / (i.astype(dtype) - j.astype(dtype) + 0.5)))
+
+
+def pei(n: int, alpha: float = 1.0, grid: Grid | None = None,
+        dtype=jnp.float64):
+    """alpha I + ones (``El::Pei``)."""
+    A = _empty(n, n, grid or default_grid(), dtype)
+    return index_dependent_fill(
+        A, lambda i, j: jnp.where(i == j, jnp.asarray(alpha + 1, dtype),
+                                  jnp.asarray(1, dtype)))
+
+
+def redheffer(n: int, grid: Grid | None = None, dtype=jnp.float64):
+    """R[i,j] = 1 if j == 0 or (j+1) % (i+1) == 0 (``El::Redheffer``)."""
+    A = _empty(n, n, grid or default_grid(), dtype)
+    return index_dependent_fill(
+        A, lambda i, j: ((j == 0) | ((j + 1) % (i + 1) == 0)).astype(dtype))
+
+
+def triw(n: int, alpha, k: int | None = None, grid: Grid | None = None,
+         dtype=jnp.float64):
+    """Upper triangular with 1s on the diagonal and ``alpha`` on the next
+    ``k`` superdiagonals (``El::TriW``)."""
+    k = n - 1 if k is None else k
+    A = _empty(n, n, grid or default_grid(), dtype)
+    return index_dependent_fill(
+        A, lambda i, j: jnp.where(i == j, jnp.asarray(1, dtype),
+                                  jnp.where((j > i) & (j <= i + k),
+                                            jnp.asarray(alpha, dtype),
+                                            jnp.asarray(0, dtype))))
+
+
+def gear(n: int, i_off: int = 1, j_off: int = -1, grid: Grid | None = None,
+         dtype=jnp.float64):
+    """Gear matrix (``El::Gear``): sub/super-diagonal of ones plus two
+    corner entries."""
+    i_idx = abs(i_off) - 1 if i_off != 0 else 0
+    j_idx = n - abs(j_off) if j_off != 0 else n - 1
+    si = float(np.sign(i_off))
+    sj = float(np.sign(j_off))
+    A = _empty(n, n, grid or default_grid(), dtype)
+
+    def f(i, j):
+        off = ((i == j + 1) | (j == i + 1)).astype(dtype)
+        c1 = (i == 0) & (j == i_idx)
+        c2 = (i == n - 1) & (j == j_idx)
+        # corners OVERWRITE (El::Gear uses Set, not Update)
+        return jnp.where(c1, jnp.asarray(si, dtype),
+                         jnp.where(c2, jnp.asarray(sj, dtype), off))
+
+    return index_dependent_fill(A, f)
+
+
+def gepp_growth(n: int, grid: Grid | None = None, dtype=jnp.float64):
+    """Wilkinson's GEPP growth-factor matrix (``El::GEPPGrowth``):
+    identity lower triangle of -1s with a ones last column."""
+    A = _empty(n, n, grid or default_grid(), dtype)
+
+    def f(i, j):
+        return jnp.where(i == j, jnp.asarray(1, dtype),
+                         jnp.where(j == n - 1, jnp.asarray(1, dtype),
+                                   jnp.where(i > j, jnp.asarray(-1, dtype),
+                                             jnp.asarray(0, dtype))))
+
+    return index_dependent_fill(A, f)
+
+
+# ---------------------------------------------------------------------
+# random generators (device-side, rank-seeded per shard)
+# ---------------------------------------------------------------------
+
+def _device_random(m: int, n: int, grid: Grid, dtype, seed: int, sampler):
+    """Per-shard device-side sampling: each device draws its local block
+    from a stream folded by its mesh rank (the reference's rank-seeded
+    per-process PRNGs).  Layout-dependent by design; never materializes
+    the global matrix anywhere."""
+    meta = DistMatrix(None, (m, n), MC, MR, 0, 0, grid)
+    lshape = (meta.local_rows, meta.local_cols)   # per-device block
+
+    def f():
+        r = jax.lax.axis_index("mc")
+        c = jax.lax.axis_index("mr")
+        key = jax.random.fold_in(jax.random.PRNGKey(seed),
+                                 r * grid.width + c)
+        return sampler(key, lshape)
+
+    stor = jax.shard_map(f, mesh=grid.mesh, in_specs=(),
+                         out_specs=P("mc", "mr"), check_vma=False)()
+    out = meta.with_local(stor)
+    # re-zero padding to keep the library invariant
+    from ..blas.level1 import _valid_mask
+    return out.with_local(jnp.where(_valid_mask(out), out.local, 0))
+
+
+def gaussian_device(m: int, n: int | None = None, grid: Grid | None = None,
+                    dtype=jnp.float32, seed: int = 0) -> DistMatrix:
+    """Device-side standard normal (``El::Gaussian`` with rank seeding)."""
+    n = n or m
+    grid = grid or default_grid()
+    if jnp.issubdtype(dtype, jnp.complexfloating):
+        def sampler(key, shape):
+            kr, ki = jax.random.split(key)
+            rd = jnp.zeros((), dtype).real.dtype
+            return (jax.random.normal(kr, shape, rd)
+                    + 1j * jax.random.normal(ki, shape, rd)).astype(dtype)
+    else:
+        def sampler(key, shape):
+            return jax.random.normal(key, shape, dtype)
+    return _device_random(m, n, grid, dtype, seed, sampler)
+
+
+def uniform_device(m: int, n: int | None = None, grid: Grid | None = None,
+                   dtype=jnp.float32, seed: int = 0, lo=0.0,
+                   hi=1.0) -> DistMatrix:
+    """Device-side uniform (``El::Uniform`` with rank seeding)."""
+    n = n or m
+    grid = grid or default_grid()
+
+    def sampler(key, shape):
+        return jax.random.uniform(key, shape, dtype, lo, hi)
+
+    return _device_random(m, n, grid, dtype, seed, sampler)
+
+
+def bernoulli(m: int, n: int | None = None, p: float = 0.5,
+              grid: Grid | None = None, dtype=jnp.float32,
+              seed: int = 0) -> DistMatrix:
+    """0/1 Bernoulli(p) entries (``El::Bernoulli``), device-side."""
+    n = n or m
+    grid = grid or default_grid()
+
+    def sampler(key, shape):
+        return jax.random.bernoulli(key, p, shape).astype(dtype)
+
+    return _device_random(m, n, grid, dtype, seed, sampler)
+
+
+def rademacher(m: int, n: int | None = None, grid: Grid | None = None,
+               dtype=jnp.float32, seed: int = 0) -> DistMatrix:
+    """+-1 entries (``El::Rademacher``), device-side."""
+    n = n or m
+    grid = grid or default_grid()
+
+    def sampler(key, shape):
+        return (2 * jax.random.bernoulli(key, 0.5, shape).astype(dtype) - 1)
+
+    return _device_random(m, n, grid, dtype, seed, sampler)
+
+
+def wigner(n: int, grid: Grid | None = None, dtype=jnp.float64,
+           seed: int = 0) -> DistMatrix:
+    """Symmetric Gaussian (Wigner) matrix (``El::Wigner``)."""
+    from ..blas.level1 import make_symmetric
+    G = _host_gaussian(n, n, grid=grid, dtype=dtype, seed=seed)
+    return make_symmetric(
+        G.with_local(G.local / math.sqrt(2.0 * n)), "L",
+        conj=jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating))
+
+
+def haar(n: int, grid: Grid | None = None, dtype=jnp.float64,
+         seed: int = 0) -> DistMatrix:
+    """Haar-distributed orthogonal/unitary matrix via QR of a Gaussian
+    (``El::Haar``)."""
+    from ..lapack.qr import qr, explicit_q
+    G = _host_gaussian(n, n, grid=grid, dtype=dtype, seed=seed)
+    Ap, tau = qr(G)
+    return explicit_q(Ap, tau)
+
+
+def normal_uniform_spectrum(n: int, center=0.0, radius: float = 1.0,
+                            grid: Grid | None = None, dtype=jnp.complex128,
+                            seed: int = 0) -> DistMatrix:
+    """Normal matrix with eigenvalues uniform in a disk
+    (``El::NormalUniformSpectrum``): Q diag(lam) Q^H, Q Haar."""
+    if not jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating):
+        raise ValueError("normal_uniform_spectrum's spectrum is genuinely "
+                         "complex; pass a complex dtype")
+    rng = np.random.default_rng(seed)
+    rad = radius * np.sqrt(rng.uniform(size=n))
+    ang = rng.uniform(0, 2 * np.pi, size=n)
+    lam = center + rad * np.exp(1j * ang)
+    Gq = rng.normal(size=(n, n)) + 1j * rng.normal(size=(n, n))
+    Q, _ = np.linalg.qr(Gq)
+    A = (Q * lam) @ Q.conj().T
+    return from_global(A.astype(np.dtype(dtype)), MC, MR,
+                       grid=grid or default_grid())

@@ -1,0 +1,135 @@
+"""Gallery generators vs numpy/scipy constructions; device-RNG properties;
+IO round-trips."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import elemental_tpu as el
+from elemental_tpu import matrices as M
+
+
+def _t(A):
+    return np.asarray(el.to_global(A))
+
+
+def test_fourier(grid24):
+    n = 8
+    F = _t(M.fourier(n, grid=grid24))
+    ref = np.exp(-2j * np.pi * np.outer(np.arange(n), np.arange(n)) / n) \
+        / np.sqrt(n)
+    assert np.linalg.norm(F - ref) < 1e-14
+    assert np.linalg.norm(F @ F.conj().T - np.eye(n)) < 1e-13
+
+
+def test_toeplitz_hankel_circulant(grid24):
+    sla = pytest.importorskip("scipy.linalg")
+    rng = np.random.default_rng(0)
+    c = rng.normal(size=5)
+    r = rng.normal(size=7)
+    r[0] = c[0]
+    assert np.allclose(_t(M.toeplitz(c, r, grid=grid24)), sla.toeplitz(c, r))
+    assert np.allclose(_t(M.circulant(c, grid=grid24)), sla.circulant(c))
+    rh = rng.normal(size=6)
+    rh[0] = c[-1]
+    assert np.allclose(_t(M.hankel(c, rh, grid=grid24)), sla.hankel(c, rh))
+
+
+def test_cauchy_walsh_wilkinson(grid24):
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=6)
+    y = rng.normal(size=5) + 10
+    C = _t(M.cauchy(x, y, grid=grid24))
+    assert np.allclose(C, 1.0 / (x[:, None] - y[None, :]))
+    W = _t(M.walsh(3, grid=grid24))
+    assert np.allclose(W @ W.T, 8 * np.eye(8))
+    Wk = _t(M.wilkinson(3, grid=grid24))
+    assert np.allclose(np.diag(Wk), [3, 2, 1, 0, 1, 2, 3])
+    assert np.allclose(np.diag(Wk, 1), 1)
+
+
+def test_laplacians_spd(grid24):
+    L1 = _t(M.laplacian_1d(9, grid=grid24))
+    assert np.all(np.linalg.eigvalsh(L1) > 0)
+    L2 = _t(M.laplacian_2d(3, 4, grid=grid24))
+    assert np.allclose(L2, L2.T)
+    assert np.all(np.linalg.eigvalsh(L2) > 0)
+
+
+def test_structured_misc(grid24):
+    J = _t(M.jordan(5, 2.5, grid=grid24))
+    assert np.allclose(J, 2.5 * np.eye(5) + np.eye(5, k=1))
+    K = _t(M.kahan(6, 0.5, grid=grid24))
+    assert np.allclose(np.diag(K), (np.sqrt(0.75)) ** np.arange(6))
+    G = _t(M.grcar(7, grid=grid24))
+    assert np.allclose(np.diag(G, -1), -1) and np.allclose(np.diag(G), 1)
+    P = _t(M.pei(5, 3.0, grid=grid24))
+    assert np.allclose(P, 3 * np.eye(5) + np.ones((5, 5)))
+    R = _t(M.redheffer(8, grid=grid24))
+    assert R[0].sum() == 8 and R[3, 7] == 1 and R[3, 6] == 0
+    T = _t(M.triw(5, -2.0, grid=grid24))
+    assert np.allclose(T, np.eye(5) - 2 * np.triu(np.ones((5, 5)), 1))
+    GG = _t(M.gepp_growth(6, grid=grid24))
+    LU = np.linalg.qr(GG)  # just ensure well-formed; growth checked in lu tests
+    assert GG[-1, -1] == 1 and GG[2, 0] == -1
+
+
+def test_device_rng(grid24, grid42):
+    A = M.gaussian_device(32, 24, grid=grid24, seed=7)
+    Ag = _t(A)
+    assert 0.8 < Ag.std() < 1.2
+    # deterministic per (grid, seed)
+    B = M.gaussian_device(32, 24, grid=grid24, seed=7)
+    assert np.array_equal(Ag, _t(B))
+    # different seed -> different draw
+    C = M.gaussian_device(32, 24, grid=grid24, seed=8)
+    assert not np.array_equal(Ag, _t(C))
+    U = _t(M.uniform_device(16, grid=grid24, lo=2.0, hi=3.0))
+    assert U.min() >= 2.0 and U.max() <= 3.0
+    Rm = _t(M.rademacher(16, grid=grid24))
+    assert set(np.unique(Rm)) <= {-1.0, 1.0}
+
+
+def test_wigner_haar_spectrum(grid24):
+    W = _t(M.wigner(16, grid=grid24))
+    assert np.allclose(W, W.T)
+    H = _t(M.haar(12, grid=grid24))
+    assert np.linalg.norm(H.T @ H - np.eye(12)) < 1e-13
+    N = _t(M.normal_uniform_spectrum(10, center=1.0, radius=0.5, grid=grid24))
+    ev = np.linalg.eigvals(N)
+    assert np.all(np.abs(ev - 1.0) <= 0.5 + 1e-10)
+    assert np.linalg.norm(N @ N.conj().T - N.conj().T @ N) < 1e-12
+
+
+def test_io_roundtrips(grid24):
+    rng = np.random.default_rng(2)
+    F = rng.normal(size=(13, 9))
+    A = el.from_global(F, el.MC, el.MR, grid=grid24)
+    with tempfile.TemporaryDirectory() as td:
+        el.write_matrix(A, os.path.join(td, "a"), format="npy")
+        B = el.read_matrix(os.path.join(td, "a"), grid=grid24)
+        assert np.array_equal(_t(B), F)
+        el.write_matrix(A, os.path.join(td, "s"), format="shards")
+        C = el.read_matrix(os.path.join(td, "s"), grid=grid24)
+        assert np.array_equal(_t(C), F)
+        el.checkpoint(os.path.join(td, "ck"), x=A, y=B)
+        got = el.restore(os.path.join(td, "ck"), ["x", "y"], grid=grid24)
+        assert np.array_equal(_t(got["x"]), F)
+    # wrong-grid shard reload is refused with a clear error
+    import jax
+    with tempfile.TemporaryDirectory() as td:
+        el.write_matrix(A, os.path.join(td, "s"), format="shards")
+        g2 = el.Grid(jax.devices(), height=4)
+        with pytest.raises(ValueError, match="grid"):
+            el.read_matrix(os.path.join(td, "s"), grid=g2)
+
+
+def test_print_matrix(grid24, capsys):
+    import io as _io
+    F = np.arange(6.0).reshape(2, 3)
+    A = el.from_global(F, el.MC, el.MR, grid=grid24)
+    buf = _io.StringIO()
+    el.print_matrix(A, title="T", stream=buf)
+    out = buf.getvalue()
+    assert "T" in out and "5." in out
