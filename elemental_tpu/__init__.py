@@ -18,7 +18,9 @@ from .blas import (gemm, herk, syrk, trrk, trsm, trr2k, her2k, syr2k,
 from .blas import gemv, ger, hemv, symv, her2, trmv, trsv
 from .lapack import cholesky, hpd_solve, cholesky_solve_after
 from .lapack import lu, lu_solve, lu_solve_after, permute_rows, permute_cols
-from .lapack import qr, apply_q, explicit_q, least_squares, tsqr
+from .lapack import (qr, apply_q, explicit_q, least_squares, tsqr, lq,
+                     apply_q_lq, explicit_l, qr_col_piv)
+from .lapack import ridge, tikhonov, lse, glm
 from .lapack import (hermitian_tridiag, apply_q_herm_tridiag, hessenberg,
                      apply_q_hessenberg, bidiag, apply_p_bidiag)
 from .lapack import ldl, ldl_solve_after, symmetric_solve, hermitian_solve, inertia
@@ -26,7 +28,7 @@ from .lapack import (polar, sign, inverse, triangular_inverse, hpd_inverse,
                      pseudoinverse, square_root, hpd_square_root)
 from .lapack import herm_eig, skew_herm_eig, herm_gen_def_eig, hermitian_svd, svd
 from .redist.interior import interior_view, interior_update, vstack, hstack
-from .optimization import (MehrotraCtrl, lp, qp, soft_threshold, svt,
+from .optimization import (MehrotraCtrl, lp, qp, socp, soft_threshold, svt,
                            bp, lav, nnls, lasso, svm, rpca)
 from .control import sylvester, lyapunov, riccati
 from .lapack.schur import schur, triang_eig, eig, pseudospectra

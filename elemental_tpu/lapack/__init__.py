@@ -2,7 +2,9 @@
 SURVEY.md §3.4 / §8.2)."""
 from .cholesky import cholesky, hpd_solve, cholesky_solve_after
 from .lu import lu, lu_solve, lu_solve_after, permute_rows, permute_cols
-from .qr import qr, apply_q, explicit_q, least_squares, tsqr
+from .qr import (qr, apply_q, explicit_q, least_squares, tsqr, lq,
+                 apply_q_lq, explicit_l, qr_col_piv)
+from .euclidean_min import ridge, tikhonov, lse, glm
 from .condense import (hermitian_tridiag, apply_q_herm_tridiag, hessenberg,
                        apply_q_hessenberg, bidiag, apply_p_bidiag)
 from .ldl import (ldl, ldl_solve_after, symmetric_solve, hermitian_solve,

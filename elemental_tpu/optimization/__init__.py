@@ -6,6 +6,8 @@ Reference: Elemental ``src/optimization/{solvers,util,prox,models}/**``.
 from .util import MehrotraCtrl, max_step, num_outside, safe_div
 from .lp import lp
 from .qp import qp
+from .soc import (socp, make_cone_layout, soc_dets, soc_apply, soc_inverse,
+                  soc_sqrt, soc_identity, soc_max_step, soc_nesterov_todd)
 from .prox import (soft_threshold, svt, clip, frobenius_prox,
                    hinge_loss_prox, logistic_prox)
 from .models import bp, lav, nnls, lasso, svm, rpca

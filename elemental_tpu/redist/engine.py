@@ -106,6 +106,119 @@ def _partial_filter_dim(x, dim: int, nblocks: int, sub_rank, l_out: int):
 
 
 # ---------------------------------------------------------------------
+# fused M <-> V conversions (one all_to_all; the reference's
+# copy::Exchange-class kernels, mn/p volume instead of the mn/r gather)
+# ---------------------------------------------------------------------
+
+def _fused_to_v(A: DistMatrix) -> DistMatrix:
+    """[MC,MR] -> [VC,STAR] or [MR,MC] -> [VR,STAR]: the V dist refines the
+    row dist, so ONE all_to_all over the column axis both refines the rows
+    and rebuilds the full column extent (each peer contributes its cyclic
+    column slice; the interleave positions land exactly at the natural
+    global order)."""
+    g = A.grid
+    r, c = g.height, g.width
+    p = r * c
+    m, n = A.gshape
+    if A.dist == (MC, MR):
+        ax, n_other, dst = "mr", c, VC
+    else:                                   # (MR, MC)
+        ax, n_other, dst = "mc", r, VR
+    lt = ix.max_local_length(m, p)
+    x = _pad_dim(A.local, 0, n_other * lt)
+    lc = x.shape[1]
+    x3 = x.reshape(lt, n_other, lc)         # row t = w*n_other + g
+    y = lax.all_to_all(x3, ax, split_axis=1, concat_axis=1)
+    z = jnp.moveaxis(y, 1, 2).reshape(lt, lc * n_other)
+    z = lax.slice_in_dim(z, 0, n, axis=1)
+    v = rank_of(dst, r, c)
+    gi = jnp.arange(lt) * p + v
+    z = jnp.where((gi < m)[:, None], z, 0)
+    return DistMatrix(z, A.gshape, dst, STAR, 0, 0, g)
+
+
+def _fused_from_v(A: DistMatrix) -> DistMatrix:
+    """[VC,STAR] -> [MC,MR] or [VR,STAR] -> [MR,MC] (inverse of
+    :func:`_fused_to_v`; one all_to_all over the target column axis)."""
+    g = A.grid
+    r, c = g.height, g.width
+    p = r * c
+    m, n = A.gshape
+    if A.cdist is VC:
+        ax, n_other, dst = "mr", c, (MC, MR)
+        S_row = r
+    else:                                   # VR
+        ax, n_other, dst = "mc", r, (MR, MC)
+        S_row = c
+    lp = A.local.shape[0]                   # ceil(m/p)
+    lcd = ix.max_local_length(n, n_other)
+    x = _pad_dim(A.local, 1, n_other * lcd)
+    x3 = x.reshape(lp, lcd, n_other)        # col j = u*n_other + s
+    y = lax.all_to_all(x3, ax, split_axis=2, concat_axis=2)
+    z = jnp.moveaxis(y, 2, 1).reshape(lp * n_other, lcd)
+    lr = ix.max_local_length(m, S_row)
+    z = lax.slice_in_dim(z, 0, lr, axis=0)
+    q_row = rank_of(dst[0], r, c)
+    gi = jnp.arange(lr) * S_row + q_row
+    q_col = rank_of(dst[1], r, c)
+    gj = jnp.arange(lcd) * n_other + q_col
+    z = jnp.where((gi < m)[:, None] & (gj < n)[None, :], z, 0)
+    return DistMatrix(z, A.gshape, dst[0], dst[1], 0, 0, g)
+
+
+def _t_meta(A: DistMatrix) -> DistMatrix:
+    """Local transpose + swapped metadata (free; used to reuse the fused
+    row-kernels for the [STAR,V] column forms)."""
+    m, n = A.gshape
+    return DistMatrix(A.local.T, (n, m), A.rdist, A.cdist,
+                      A.ralign, A.calign, A.grid)
+
+
+def _fused_dispatch(A: DistMatrix, dst) -> DistMatrix | None:
+    src = A.dist
+    if src == (MC, MR) and dst == (VC, STAR):
+        return _fused_to_v(A)
+    if src == (MR, MC) and dst == (VR, STAR):
+        return _fused_to_v(A)
+    if src == (VC, STAR) and dst == (MC, MR):
+        return _fused_from_v(A)
+    if src == (VR, STAR) and dst == (MR, MC):
+        return _fused_from_v(A)
+    # transposed (column) forms ride the row kernels on the local transpose
+    if src == (MC, MR) and dst == (STAR, VR):
+        return _t_meta(_fused_to_v(_t_meta(A)))
+    if src == (MR, MC) and dst == (STAR, VC):
+        return _t_meta(_fused_to_v(_t_meta(A)))
+    if src == (STAR, VR) and dst == (MC, MR):
+        return _t_meta(_fused_from_v(_t_meta(A)))
+    if src == (STAR, VC) and dst == (MR, MC):
+        return _t_meta(_fused_from_v(_t_meta(A)))
+    return None
+
+
+# ---------------------------------------------------------------------
+# re-alignment (pure ppermute rotation per dim)
+# ---------------------------------------------------------------------
+
+def _realign(A: DistMatrix, calign: int, ralign: int) -> DistMatrix:
+    """Change alignments in place: owner of index i moves from (i+a)%S to
+    (i+a')%S -- a wholesale device ROTATION per dim, no local rearrangement
+    (the reference's aligned-copy SendRecv)."""
+    from .interior import _rot_perm
+    g = A.grid
+    r, c = g.height, g.width
+    x = A.local
+    for dim, d, a_old, a_new in ((0, A.cdist, A.calign, calign),
+                                 (1, A.rdist, A.ralign, ralign)):
+        S = dist_stride(d, r, c)
+        if S == 1 or a_old == a_new:
+            continue
+        axes, perm = _rot_perm(d, (a_old - a_new) % S, r, c)
+        x = lax.ppermute(x, axes, perm)
+    return DistMatrix(x, A.gshape, A.cdist, A.rdist, calign, ralign, A.grid)
+
+
+# ---------------------------------------------------------------------
 # whole-matrix operations (inside shard_map)
 # ---------------------------------------------------------------------
 
@@ -160,65 +273,73 @@ def to_dist(A: DistMatrix, cdist: Dist, rdist: Dist,
     if src == dst and (A.calign, A.ralign) == (calign, ralign):
         return A
 
+    # alignment-only change: a pure per-dim device rotation
+    if src == dst:
+        return _realign(A, calign, ralign)
+    # misaligned source / aligned target: rotate to/from zero alignment so
+    # every dist change runs on the zero-aligned fast paths (this removes
+    # the [STAR,STAR] fallback from all aligned redistributions)
+    if not _zero_aligned(A):
+        return to_dist(_realign(A, 0, 0), cdist, rdist, calign, ralign)
+    if (calign, ralign) != (0, 0):
+        out = to_dist(A, cdist, rdist, 0, 0)
+        return _realign(out, calign, ralign)
+
     # ---- fast paths (zero alignments) --------------------------------
-    if _zero_aligned(A) and calign == 0 and ralign == 0:
-        # pure row-dim change, column dist untouched
-        if A.cdist is cdist:
-            out = _rowdim_change(A, rdist)
-            if out is not None:
-                return out
-        # pure col-dim change, row dist untouched
-        if A.rdist is rdist:
-            out = _coldim_change(A, cdist)
-            if out is not None:
-                return out
-        # composite chains of fast single-dim hops
-        chain = _CHAINS.get((src, dst))
-        if chain is not None:
-            out = A
-            for hop in chain:
-                out = to_dist(out, *hop)
+    out = _fused_dispatch(A, dst)
+    if out is not None:
+        return out
+    # pure row-dim change, column dist untouched
+    if A.cdist is cdist:
+        out = _rowdim_change(A, rdist)
+        if out is not None:
             return out
+    # pure col-dim change, row dist untouched
+    if A.rdist is rdist:
+        out = _coldim_change(A, cdist)
+        if out is not None:
+            return out
+    # composite chains of fast single-dim hops
+    chain = _CHAINS.get((src, dst))
+    if chain is not None:
+        out = A
+        for hop in chain:
+            out = to_dist(out, *hop)
+        return out
 
     # ---- generic fallback: through [STAR,STAR] ------------------------
     ss = to_star_star(A)
     return _from_star_star(ss.local, A.gshape, cdist, rdist, calign, ralign, g)
 
 
-#: Multi-hop routes (each hop is a fast single-dim change) for the pairs the
-#: blocked algorithms actually use.  The reference implements these as fused
-#: kernels (e.g. ``copy::Exchange`` for the [MC,MR]<->[MR,MC] transpose pair,
-#: ``src/blas_like/level1/Copy/Exchange.hpp``).  NOTE: chains whose first hop
-#: is a gather pay more ICI volume than a fused all_to_all would (~mn/r per
-#: device vs mn/p for the exchange pair) -- replacing the gather+filter hops
-#: with ``lax.all_to_all`` promote/demote kernels is a known optimization.
+#: Multi-hop routes for the pairs without a dedicated kernel.  Every route
+#: now rides the FUSED all_to_all M<->V conversions (:func:`_fused_to_v` /
+#: :func:`_fused_from_v`, mn/p volume per hop) plus the [VC]<->[VR]
+#: ppermute -- the reference's ``copy::Exchange`` family
+#: (``src/blas_like/level1/Copy/Exchange.hpp``); the old gather+filter
+#: first hops (mn/r volume) are gone.
 _CHAINS = {
-    # transpose-pair exchange
-    ((MC, MR), (MR, MC)): ((MC, STAR), (VC, STAR), (VR, STAR), (MR, STAR), (MR, MC)),
-    ((MR, MC), (MC, MR)): ((MR, STAR), (VR, STAR), (VC, STAR), (MC, STAR), (MC, MR)),
-    # [MC,MR] -> 1-D cyclic forms and back
-    ((MC, MR), (VC, STAR)): ((MC, STAR), (VC, STAR)),
-    ((MC, MR), (VR, STAR)): ((MC, STAR), (VC, STAR), (VR, STAR)),
-    ((MC, MR), (STAR, VR)): ((STAR, MR), (STAR, VR)),
-    ((MC, MR), (STAR, VC)): ((STAR, MR), (STAR, VR), (STAR, VC)),
-    ((VC, STAR), (MC, MR)): ((MC, STAR), (MC, MR)),
-    ((VR, STAR), (MC, MR)): ((VC, STAR), (MC, STAR), (MC, MR)),
-    ((STAR, VR), (MC, MR)): ((STAR, MR), (MC, MR)),
-    ((STAR, VC), (MC, MR)): ((STAR, VR), (STAR, MR), (MC, MR)),
-    # [MR,MC] -> 1-D cyclic forms and back
-    ((MR, MC), (VR, STAR)): ((MR, STAR), (VR, STAR)),
-    ((MR, MC), (STAR, VC)): ((STAR, MC), (STAR, VC)),
-    ((VR, STAR), (MR, MC)): ((MR, STAR), (MR, MC)),
-    ((STAR, VC), (MR, MC)): ((STAR, MC), (MR, MC)),
+    # transpose-pair exchange: fused demote, ppermute, fused promote
+    ((MC, MR), (MR, MC)): ((VC, STAR), (VR, STAR), (MR, MC)),
+    ((MR, MC), (MC, MR)): ((VR, STAR), (VC, STAR), (MC, MR)),
+    # remaining 1-D cyclic forms (the directly-fused ones dispatch earlier)
+    ((MC, MR), (VR, STAR)): ((VC, STAR), (VR, STAR)),
+    ((MC, MR), (STAR, VC)): ((STAR, VR), (STAR, VC)),
+    ((VR, STAR), (MC, MR)): ((VC, STAR), (MC, MR)),
+    ((STAR, VC), (MC, MR)): ((STAR, VR), (MC, MR)),
+    ((MR, MC), (VC, STAR)): ((VR, STAR), (VC, STAR)),
+    ((MR, MC), (STAR, VR)): ((STAR, VC), (STAR, VR)),
+    ((VC, STAR), (MR, MC)): ((VR, STAR), (MR, MC)),
+    ((STAR, VR), (MR, MC)): ((STAR, VC), (MR, MC)),
     # cross-dim single-replicated targets (SUMMA panel moves)
-    ((MC, MR), (MR, STAR)): ((MC, STAR), (VC, STAR), (VR, STAR), (MR, STAR)),
-    ((MC, MR), (STAR, MC)): ((STAR, MR), (STAR, VR), (STAR, VC), (STAR, MC)),
-    ((MR, MC), (MC, STAR)): ((MR, STAR), (VR, STAR), (VC, STAR), (MC, STAR)),
-    ((MR, MC), (STAR, MR)): ((STAR, MC), (STAR, VC), (STAR, VR), (STAR, MR)),
-    ((MR, STAR), (MC, MR)): ((VR, STAR), (VC, STAR), (MC, STAR), (MC, MR)),
-    ((STAR, MC), (MC, MR)): ((STAR, VC), (STAR, VR), (STAR, MR), (MC, MR)),
-    ((MC, STAR), (MR, MC)): ((VC, STAR), (VR, STAR), (MR, STAR), (MR, MC)),
-    ((STAR, MR), (MR, MC)): ((STAR, VR), (STAR, VC), (STAR, MC), (MR, MC)),
+    ((MC, MR), (MR, STAR)): ((VC, STAR), (VR, STAR), (MR, STAR)),
+    ((MC, MR), (STAR, MC)): ((STAR, VR), (STAR, VC), (STAR, MC)),
+    ((MR, MC), (MC, STAR)): ((VR, STAR), (VC, STAR), (MC, STAR)),
+    ((MR, MC), (STAR, MR)): ((STAR, VC), (STAR, VR), (STAR, MR)),
+    ((MR, STAR), (MC, MR)): ((VR, STAR), (VC, STAR), (MC, MR)),
+    ((STAR, MC), (MC, MR)): ((STAR, VC), (STAR, VR), (MC, MR)),
+    ((MC, STAR), (MR, MC)): ((VC, STAR), (VR, STAR), (MR, MC)),
+    ((STAR, MR), (MR, MC)): ((STAR, VR), (STAR, VC), (MR, MC)),
     # V-form to the opposite M-form (Cholesky/Herk panel adjoint chains)
     ((VC, STAR), (MR, STAR)): ((VR, STAR), (MR, STAR)),
     ((VR, STAR), (MC, STAR)): ((VC, STAR), (MC, STAR)),

@@ -35,12 +35,12 @@ def test_gemv(grid24, orient):
                                2.0 * opA @ x - 1.5 * y, rtol=1e-12)
 
 
-def test_gemv_real_any_grid(any_grid):
+def test_gemv_real_two_grids(two_grids):
     rng = np.random.default_rng(1)
     A = _mat(rng, 17, 6, np.float64)
     x = _vec(rng, 6, np.float64)
-    Ad = from_global(A, MC, MR, grid=any_grid)
-    xd = from_global(x, MC, MR, grid=any_grid)
+    Ad = from_global(A, MC, MR, grid=two_grids)
+    xd = from_global(x, MC, MR, grid=two_grids)
     np.testing.assert_allclose(np.asarray(to_global(el.gemv(Ad, xd))),
                                A @ x, rtol=1e-12)
 

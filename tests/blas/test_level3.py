@@ -52,11 +52,11 @@ def test_gemm_alpha_beta(grid24):
                                rtol=1e-12)
 
 
-def test_gemm_any_grid(any_grid):
+def test_gemm_two_grids(two_grids):
     rng = _rng(4)
     m, k, n = 13, 21, 8
     A, B = rng.normal(size=(m, k)), rng.normal(size=(k, n))
-    C = l3.gemm(_dist(any_grid, A), _dist(any_grid, B), nb=16)
+    C = l3.gemm(_dist(two_grids, A), _dist(two_grids, B), nb=16)
     np.testing.assert_allclose(np.asarray(to_global(C)), A @ B, rtol=1e-12)
 
 

@@ -31,6 +31,16 @@ def any_grid(request):
     return Grid(jax.devices()[: r * c], height=r)
 
 
+@pytest.fixture(scope="session", params=[(2, 4), (1, 8)],
+                ids=lambda rc: f"grid{rc[0]}x{rc[1]}")
+def two_grids(request):
+    """A generic 2-D grid plus one degenerate (stride-1) grid: the cheap
+    tier for blocked-algorithm tests (the full 4-grid sweep stays on the
+    core redistribution conformance)."""
+    r, c = request.param
+    return Grid(jax.devices()[: r * c], height=r)
+
+
 @pytest.fixture(scope="session")
 def grid24():
     return Grid(jax.devices(), height=2)

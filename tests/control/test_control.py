@@ -38,6 +38,7 @@ def test_lyapunov(grid24):
     assert np.linalg.norm(A @ X + X @ A.T - C) / np.linalg.norm(C) < 1e-12
 
 
+@pytest.mark.slow
 def test_riccati(grid24):
     scipy_linalg = pytest.importorskip("scipy.linalg")
     rng = np.random.default_rng(2)

@@ -40,9 +40,9 @@ def test_cholesky_reads_only_triangle(grid42):
     np.testing.assert_allclose(np.asarray(to_global(Ld)), want, rtol=1e-10)
 
 
-def test_cholesky_any_grid_ragged(any_grid):
+def test_cholesky_two_grids_ragged(two_grids):
     n = 19     # deliberately not a multiple of any grid dim
-    A = hermitian_uniform_spectrum(n, 1, 4, any_grid, dtype=np.float64, seed=5)
+    A = hermitian_uniform_spectrum(n, 1, 4, two_grids, dtype=np.float64, seed=5)
     F = np.asarray(to_global(A))
     L = np.asarray(to_global(el.cholesky(A, nb=8)))
     assert np.linalg.norm(F - L @ L.T) / np.linalg.norm(F) < 1e-13

@@ -26,8 +26,8 @@ def _tridiag_full(d, e):
     return np.diag(np.asarray(d)) + np.diag(np.asarray(e), -1) + np.diag(np.asarray(e), 1)
 
 
-@pytest.mark.parametrize("dtype", [jnp.float64, jnp.complex128])
-@pytest.mark.parametrize("n", [24, 37])
+@pytest.mark.parametrize("dtype", [jnp.float64, pytest.param(jnp.complex128, marks=pytest.mark.slow)])
+@pytest.mark.parametrize("n", [24, pytest.param(37, marks=pytest.mark.slow)])
 def test_hermitian_tridiag(grid24, dtype, n):
     A = _herm(n, dtype)
     Ad = from_global(A, MC, MR, grid24)
@@ -116,12 +116,14 @@ def test_bidiag_square_full_panel(grid24):
     _check_bidiag(rng.normal(size=(16, 16)), grid24, nb=16)
 
 
+@pytest.mark.slow
 def test_bidiag_complex(grid24):
     rng = np.random.default_rng(22)
     F = rng.normal(size=(20, 12)) + 1j * rng.normal(size=(20, 12))
     _check_bidiag(F, grid24, nb=4)
 
 
+@pytest.mark.slow
 def test_svd_golub_kahan(grid24):
     import elemental_tpu as el
     rng = np.random.default_rng(23)

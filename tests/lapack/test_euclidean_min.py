@@ -1,0 +1,77 @@
+"""Ridge / Tikhonov / LSE / GLM oracles (closed-form cross-checks)."""
+import numpy as np
+import pytest
+
+import elemental_tpu as el
+
+
+def _dm(F, grid):
+    return el.from_global(F, el.MC, el.MR, grid=grid)
+
+
+def _t(A):
+    return np.asarray(el.to_global(A))
+
+
+def test_ridge(grid24):
+    rng = np.random.default_rng(0)
+    A = rng.normal(size=(20, 8))
+    b = rng.normal(size=(20, 2))
+    x = _t(el.ridge(_dm(A, grid24), _dm(b, grid24), 1.5))
+    ref = np.linalg.solve(A.T @ A + 1.5 ** 2 * np.eye(8), A.T @ b)
+    assert np.linalg.norm(x - ref) < 1e-12
+
+
+def test_tikhonov(grid24):
+    rng = np.random.default_rng(1)
+    A = rng.normal(size=(20, 8))
+    b = rng.normal(size=(20, 1))
+    G = rng.normal(size=(5, 8))
+    x = _t(el.tikhonov(_dm(A, grid24), _dm(b, grid24), _dm(G, grid24)))
+    ref = np.linalg.solve(A.T @ A + G.T @ G, A.T @ b)
+    assert np.linalg.norm(x - ref) < 1e-12
+
+
+def test_lse(grid24):
+    rng = np.random.default_rng(2)
+    A = rng.normal(size=(20, 8))
+    b = rng.normal(size=(20, 1))
+    C = rng.normal(size=(3, 8))
+    d = rng.normal(size=(3, 1))
+    x = _t(el.lse(_dm(A, grid24), _dm(b, grid24), _dm(C, grid24),
+                  _dm(d, grid24)))
+    K = np.block([[A.T @ A, C.T], [C, np.zeros((3, 3))]])
+    ref = np.linalg.solve(K, np.vstack([A.T @ b, d]))[:8]
+    assert np.linalg.norm(x - ref) < 1e-11
+    assert np.linalg.norm(C @ x - d) < 1e-12
+
+
+def test_glm(grid24):
+    rng = np.random.default_rng(3)
+    A = rng.normal(size=(12, 4))
+    B = rng.normal(size=(12, 12))
+    d = rng.normal(size=(12, 1))
+    x, y = el.glm(_dm(A, grid24), _dm(B, grid24), _dm(d, grid24))
+    xg, yg = _t(x), _t(y)
+    assert np.linalg.norm(A @ xg + B @ yg - d) < 1e-12
+    # x matches the GLS closed form with covariance W = B B^T
+    W = B @ B.T
+    Wi = np.linalg.inv(W)
+    ref = np.linalg.solve(A.T @ Wi @ A, A.T @ Wi @ d)
+    assert np.linalg.norm(xg - ref) < 1e-10
+
+
+def test_lse_complex(grid24):
+    """Regression: the KKT blocks must use conjugate transposes."""
+    rng = np.random.default_rng(4)
+    A = rng.normal(size=(12, 5)) + 1j * rng.normal(size=(12, 5))
+    b = rng.normal(size=(12, 1)) + 1j * rng.normal(size=(12, 1))
+    C = rng.normal(size=(2, 5)) + 1j * rng.normal(size=(2, 5))
+    d = rng.normal(size=(2, 1)) + 1j * rng.normal(size=(2, 1))
+    x = _t(el.lse(_dm(A, grid24), _dm(b, grid24), _dm(C, grid24),
+                  _dm(d, grid24)))
+    K = np.block([[A.conj().T @ A, C.conj().T],
+                  [C, np.zeros((2, 2), complex)]])
+    ref = np.linalg.solve(K, np.vstack([A.conj().T @ b, d]))[:5]
+    assert np.linalg.norm(x - ref) < 1e-11
+    assert np.linalg.norm(C @ x - d) < 1e-12

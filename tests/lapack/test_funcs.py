@@ -29,6 +29,7 @@ def test_polar_square(grid24):
     assert np.min(np.linalg.eigvalsh(Hg)) > -1e-12
 
 
+@pytest.mark.slow
 def test_polar_tall_wide_complex(grid24):
     rng = np.random.default_rng(1)
     F = rng.normal(size=(32, 16))

@@ -63,12 +63,12 @@ def test_lu_solve(grid24):
     assert np.linalg.norm(F @ Xh - B) / np.linalg.norm(B) < 1e-12
 
 
-def test_lu_solve_complex_any_grid(any_grid):
+def test_lu_solve_complex_two_grids(two_grids):
     n, nrhs = 13, 3
     rng = np.random.default_rng(13)
     F = rng.normal(size=(n, n)) + 1j * rng.normal(size=(n, n)) + 2 * n * np.eye(n)
     B = rng.normal(size=(n, nrhs)) + 1j * rng.normal(size=(n, nrhs))
-    X = lu_solve(_dist(any_grid, F), _dist(any_grid, B), nb=4)
+    X = lu_solve(_dist(two_grids, F), _dist(two_grids, B), nb=4)
     assert np.linalg.norm(F @ np.asarray(to_global(X)) - B) < 1e-11 * np.linalg.norm(B)
 
 

@@ -34,6 +34,7 @@ def test_hpd_determinant(grid24):
     assert abs(det - np.linalg.det(A)) / np.linalg.det(A) < 1e-12
 
 
+@pytest.mark.slow
 def test_condition(grid24):
     rng = np.random.default_rng(3)
     A = rng.normal(size=(12, 12))
@@ -60,6 +61,7 @@ def test_matrix_inertia(grid24):
     assert (npos, nneg) == (int((w > 0).sum()), int((w < 0).sum()))
 
 
+@pytest.mark.slow
 def test_schatten_norms(grid24):
     rng = np.random.default_rng(6)
     A = rng.normal(size=(12, 9))

@@ -64,12 +64,12 @@ def test_least_squares(grid24, shape):
     np.testing.assert_allclose(np.asarray(to_global(X)), Xnp, atol=1e-10)
 
 
-def test_least_squares_complex_any_grid(any_grid):
+def test_least_squares_complex_two_grids(two_grids):
     m, n = 26, 7
     rng = np.random.default_rng(25)
     F = rng.normal(size=(m, n)) + 1j * rng.normal(size=(m, n))
     B = rng.normal(size=(m, 2)) + 1j * rng.normal(size=(m, 2))
-    X = least_squares(_dist(any_grid, F), _dist(any_grid, B), nb=4)
+    X = least_squares(_dist(two_grids, F), _dist(two_grids, B), nb=4)
     Xnp, *_ = np.linalg.lstsq(F, B, rcond=None)
     np.testing.assert_allclose(np.asarray(to_global(X)), Xnp, atol=1e-10)
 
@@ -96,3 +96,59 @@ def test_qr_jit(grid24):
     R = np.triu(np.asarray(to_global(Ap)))[:n, :]
     Rnp = np.linalg.qr(F, mode="r")
     np.testing.assert_allclose(np.abs(R), np.abs(Rnp), atol=1e-12)
+
+
+# ---------------------------------------------------------------------
+# LQ and column-pivoted QR
+# ---------------------------------------------------------------------
+
+def test_lq(grid24):
+    import elemental_tpu as el
+    rng = np.random.default_rng(30)
+    F = rng.normal(size=(8, 20))
+    A = el.from_global(F, el.MC, el.MR, grid=grid24)
+    Ap, tau = el.lq(A)
+    L = np.asarray(el.to_global(el.explicit_l(Ap)))
+    I_n = el.from_global(np.eye(20), el.MC, el.MR, grid=grid24)
+    Q = np.asarray(el.to_global(el.apply_q_lq(Ap, tau, I_n, orient="N")))
+    assert np.linalg.norm(np.triu(L, 1)) == 0
+    assert np.linalg.norm(Q.T @ Q - np.eye(20)) < 1e-12
+    assert np.linalg.norm(L @ Q[:8] - F) / np.linalg.norm(F) < 1e-13
+
+
+def _check_cpqr(F, grid, nb):
+    import elemental_tpu as el
+    from elemental_tpu.lapack.qr import qr_col_piv, apply_q
+    m, n = F.shape
+    A = el.from_global(F, el.MC, el.MR, grid=grid)
+    Ap, tau, jpvt = qr_col_piv(A, nb=nb)
+    jp = np.asarray(jpvt)
+    kend = min(m, n)
+    R = np.triu(np.asarray(el.to_global(Ap))[:kend, :])
+    I_m = el.from_global(np.eye(m, dtype=F.dtype), el.MC, el.MR, grid=grid)
+    Q = np.asarray(el.to_global(apply_q(Ap, tau, I_m, orient="N", nb=nb)))
+    perm = np.concatenate([jp, np.setdiff1d(np.arange(n), jp)]) \
+        if n > kend else jp
+    rec = Q[:, :kend] @ R
+    assert np.linalg.norm(rec - F[:, perm]) / np.linalg.norm(F) < 1e-13
+    rd = np.abs(np.diag(R))
+    assert np.all(rd[:-1] >= rd[1:] - 1e-10)     # greedy pivot order
+
+
+def test_qr_col_piv(grid24):
+    rng = np.random.default_rng(31)
+    _check_cpqr(rng.normal(size=(16, 12)), grid24, nb=4)
+    _check_cpqr(rng.normal(size=(12, 12)), grid24, nb=12)
+    Fc = rng.normal(size=(12, 8)) + 1j * rng.normal(size=(12, 8))
+    _check_cpqr(Fc, grid24, nb=4)
+
+
+def test_qr_col_piv_rank_revealing(grid24):
+    import elemental_tpu as el
+    from elemental_tpu.lapack.qr import qr_col_piv
+    rng = np.random.default_rng(32)
+    F = rng.normal(size=(16, 4)) @ rng.normal(size=(4, 12))   # rank 4
+    A = el.from_global(F, el.MC, el.MR, grid=grid24)
+    Ap, tau, jpvt = qr_col_piv(A, nb=4)
+    R = np.triu(np.asarray(el.to_global(Ap))[:12, :])
+    assert abs(R[4, 4]) < 1e-10 * abs(R[0, 0])

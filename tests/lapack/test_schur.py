@@ -31,6 +31,7 @@ def _check_schur(F, T, Q, tol=1e-12):
     assert d.min(axis=1).max() < 1e-10 * max(np.abs(ev).max(), 1)
 
 
+@pytest.mark.slow
 def test_schur_sdc_real(grid24):
     """base=12 forces >= 2 SDC levels on a real nonsymmetric matrix."""
     rng = np.random.default_rng(0)
@@ -39,6 +40,7 @@ def test_schur_sdc_real(grid24):
     _check_schur(F, T, Q)
 
 
+@pytest.mark.slow
 def test_schur_sdc_complex(grid24):
     rng = np.random.default_rng(1)
     F = rng.normal(size=(24, 24)) + 1j * rng.normal(size=(24, 24))
@@ -81,6 +83,7 @@ def test_triang_eig_defective(grid24):
     assert cols[[5, 6, 7]].max() < 1e-10
 
 
+@pytest.mark.slow
 def test_eig_general(grid24):
     rng = np.random.default_rng(4)
     F = rng.normal(size=(40, 40))

@@ -139,6 +139,7 @@ def test_svd_square(grid24):
     _check_svd(F, U, s, V)
 
 
+@pytest.mark.slow
 def test_svd_square_complex(grid24):
     rng = np.random.default_rng(9)
     F = rng.normal(size=(16, 16)) + 1j * rng.normal(size=(16, 16))
@@ -172,6 +173,7 @@ def test_svd_values_only(grid24):
 # QDWH-eig: the scalable (PMRRR-replacement) path
 # ---------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_qdwh_eig_recursive(grid24):
     """Small base forces >= 2 levels of spectral divide-and-conquer."""
     F = _sym(48, 13)
@@ -190,6 +192,7 @@ def test_qdwh_eig_public_api(grid24):
     _check_eig(F, w, Z)
 
 
+@pytest.mark.slow
 def test_qdwh_eig_clustered(grid24):
     """Near-multiple-of-identity blocks must deflate, not loop."""
     rng = np.random.default_rng(15)

@@ -42,6 +42,7 @@ def test_lp_mehrotra(grid24):
     assert abs(float(c.T @ xg) - float(b.T @ yg)) < 1e-6 * (1 + abs(float(c.T @ xg)))
 
 
+@pytest.mark.slow
 def test_lp_vs_scipy(grid24):
     scipy_opt = pytest.importorskip("scipy.optimize")
     rng = np.random.default_rng(1)
@@ -83,6 +84,7 @@ def test_nnls(grid24):
     assert np.linalg.norm(xg.ravel() - xs) < 1e-6
 
 
+@pytest.mark.slow
 def test_bp_sparse_recovery(grid24):
     rng = np.random.default_rng(4)
     m, n = 10, 24
@@ -96,6 +98,7 @@ def test_bp_sparse_recovery(grid24):
     assert np.linalg.norm(_t(x) - x_true) < 1e-6
 
 
+@pytest.mark.slow
 def test_lav_outlier_robust(grid24):
     rng = np.random.default_rng(5)
     A = rng.normal(size=(24, 6))
@@ -106,6 +109,7 @@ def test_lav_outlier_robust(grid24):
     assert np.linalg.norm(_t(x) - x_true) < 1e-6
 
 
+@pytest.mark.slow
 def test_lasso_shrinks(grid24):
     rng = np.random.default_rng(6)
     A = rng.normal(size=(16, 8))
@@ -117,6 +121,7 @@ def test_lasso_shrinks(grid24):
     assert np.all(np.abs(kkt) <= 2.0 + 1e-6)
 
 
+@pytest.mark.slow
 def test_svm_separable(grid24):
     rng = np.random.default_rng(7)
     X = np.vstack([rng.normal(size=(12, 4)) + 2,
@@ -127,6 +132,7 @@ def test_svm_separable(grid24):
     assert (pred == y).all()
 
 
+@pytest.mark.slow
 def test_rpca_recovery(grid24):
     rng = np.random.default_rng(8)
     n = 60
@@ -139,6 +145,7 @@ def test_rpca_recovery(grid24):
     assert np.linalg.norm(_t(L) - L0) / np.linalg.norm(L0) < 1e-5
 
 
+@pytest.mark.slow
 def test_prox_operators(grid24):
     rng = np.random.default_rng(9)
     F = rng.normal(size=(9, 7))
@@ -168,3 +175,59 @@ def test_logistic_prox(grid24):
     for a, x in zip(F.ravel(), got.ravel()):
         obj = rho / 2 * (grid_x - a) ** 2 + np.log1p(np.exp(-grid_x))
         assert abs(x - grid_x[np.argmin(obj)]) < 1e-3
+
+
+def _soc_interior(fi, n, seed):
+    v = np.zeros(n)
+    r2 = np.random.default_rng(seed)
+    for h in np.unique(fi):
+        sel = fi == h
+        k = sel.sum()
+        t = r2.normal(size=k - 1) * 0.3
+        v[np.where(sel)[0][1:]] = t
+        v[h] = np.linalg.norm(t) + 1.0
+    return v
+
+
+def test_soc_utilities(grid24):
+    from elemental_tpu.optimization.soc import (
+        make_cone_layout, soc_dets, soc_apply, soc_inverse, soc_identity,
+        soc_max_step, soc_nesterov_todd, _arrow_matrix)
+    sizes = [3, 5, 2]
+    orders, fi = make_cone_layout(sizes)
+    n = 10
+    x = _soc_interior(fi, n, 1)
+    z = _soc_interior(fi, n, 2)
+    e = soc_identity(fi, n)
+    assert np.allclose(soc_apply(x, soc_inverse(x, fi), fi), e, atol=1e-12)
+    w = soc_nesterov_todd(x, z, fi)
+    Qw = _arrow_matrix(w, orders, fi)
+    assert np.linalg.norm(Qw @ z - x) < 1e-12       # NT defining identity
+    assert abs(soc_max_step(x, -x, fi, cap=10.0) - 1.0) < 1e-10
+    assert soc_max_step(x, _soc_interior(fi, n, 3), fi, cap=7.0) == 7.0
+
+
+def test_socp(grid24):
+    from elemental_tpu.optimization.soc import socp, make_cone_layout
+    rng = np.random.default_rng(20)
+    sizes = [3, 4, 3]
+    n, m = 10, 4
+    orders, fi = make_cone_layout(sizes)
+    x0 = _soc_interior(fi, n, 4)
+    z0 = _soc_interior(fi, n, 5)
+    A = rng.normal(size=(m, n))
+    b = (A @ x0).reshape(-1, 1)
+    c = (A.T @ rng.normal(size=m) + z0).reshape(-1, 1)
+    x, y, z, info = el.socp(_dm(A, grid24), _dm(b, grid24), _dm(c, grid24),
+                            sizes, ctrl=MehrotraCtrl(tol=1e-7))
+    assert info["converged"] or info.get("stalled")
+    assert info["rel_gap"] < 1e-6
+    xg = _t(x).ravel()
+    yg = _t(y).ravel()
+    zg = _t(z).ravel()
+    assert np.linalg.norm(A @ xg - b.ravel()) < 1e-5
+    assert np.linalg.norm(A.T @ yg + zg - c.ravel()) < 1e-5
+    assert abs(xg @ zg) < 1e-5
+    # cone membership of the solution
+    from elemental_tpu.optimization.soc import soc_dets
+    assert np.all(soc_dets(xg, fi) > -1e-9)
