@@ -6,6 +6,12 @@ a 2-D process grid).  See SURVEY.md for the blueprint.
 """
 from .core.dist import Dist, MC, MD, MR, VC, VR, STAR, CIRC, LEGAL_PAIRS
 from .core.grid import Grid, default_grid, set_default_grid
+from .core.environment import (blocksize, set_blocksize, push_blocksize,
+                               pop_blocksize, blocksize_scope, Timer, Args,
+                               ProgressLog)
+from .core.ctrl import (SignCtrl, PolarCtrl, HermitianEigCtrl, SVDCtrl,
+                        SchurCtrl, PseudospecCtrl, LDLPivotCtrl, QRCtrl,
+                        LeastSquaresCtrl)
 from .core.distmatrix import DistMatrix, from_global, to_global, zeros
 from .redist.engine import redistribute, transpose_dist
 
@@ -16,6 +22,14 @@ from .blas import (gemm, herk, syrk, trrk, trsm, trr2k, her2k, syr2k,
                    hemm, symm, trmm, two_sided_trsm, two_sided_trmm,
                    multishift_trsm)
 from .blas import gemv, ger, hemv, symv, her2, trmv, trsv
+from .blas import (axpy, scale, fill, entrywise_map, hadamard,
+                   index_dependent_fill, make_trapezoidal, shift_diagonal,
+                   make_symmetric, get_diagonal, set_diagonal,
+                   diagonal_scale, diagonal_solve, frobenius_norm, max_norm,
+                   one_norm, infinity_norm, dot, dotu, trace, transpose,
+                   adjoint, real_part, imag_part, max_abs_loc, max_loc,
+                   scale_trapezoid, axpy_trapezoid, safe_scale,
+                   get_submatrix, set_submatrix)
 from .lapack import cholesky, hpd_solve, cholesky_solve_after
 from .lapack import lu, lu_solve, lu_solve_after, permute_rows, permute_cols
 from .lapack import (qr, apply_q, explicit_q, least_squares, tsqr, lq,

@@ -237,3 +237,155 @@ def nrm2(A: DistMatrix):
 def trace(A: DistMatrix):
     d = get_diagonal(A)
     return jnp.sum(d.local)
+
+
+# ---- orientation / parts (Transpose.cpp, RealPart.cpp, Conjugate.cpp) ----
+
+def transpose(A: DistMatrix, conj: bool = False) -> DistMatrix:
+    """B = A^T (``El::Transpose``): free dist-transpose + engine hops back to
+    A's distribution pair."""
+    return redistribute(transpose_dist(A, conj=conj), *A.dist,
+                        calign=A.calign, ralign=A.ralign)
+
+
+def adjoint(A: DistMatrix) -> DistMatrix:
+    """B = A^H (``El::Adjoint``)."""
+    return transpose(A, conj=True)
+
+
+def real_part(A: DistMatrix) -> DistMatrix:
+    """``El::RealPart`` (result is the real base dtype)."""
+    return A.with_local(jnp.real(A.local))
+
+
+def imag_part(A: DistMatrix) -> DistMatrix:
+    """``El::ImagPart``."""
+    return A.with_local(jnp.imag(A.local))
+
+
+def round_entries(A: DistMatrix) -> DistMatrix:
+    """``El::Round``: nearest integer, entrywise (complex: each part)."""
+    if jnp.iscomplexobj(A.local):
+        return A.with_local(jnp.round(jnp.real(A.local))
+                            + 1j * jnp.round(jnp.imag(A.local)))
+    return A.with_local(jnp.round(A.local))
+
+
+def swap(A: DistMatrix, B: DistMatrix):
+    """``El::Swap``: functionally, just the exchanged pair."""
+    _check_same_layout(A, B)
+    return B, A
+
+
+def dotu(A: DistMatrix, B: DistMatrix):
+    """Non-conjugated inner product (``El::Dotu``)."""
+    _check_same_layout(A, B)
+    return jnp.sum(A.local * B.local)
+
+
+# ---- extremal entries with location (MaxAbsLoc / MaxLoc family) ------
+
+def _loc_reduce(A: DistMatrix, vals, reducer):
+    """Shared (value, (i,j)) reduction over the storage array: pack the
+    global index into the comparison payload -- the ``mpi::MAXLOC`` analog
+    (value,index) pairing, done as one argmax over each-entry-once storage."""
+    I, J = _global_indices(A)
+    m, n = A.gshape
+    valid = (I[:, None] < m) & (J[None, :] < n)
+    flat = jnp.where(valid, vals, reducer.pad).reshape(-1)
+    idx = reducer.arg(flat)
+    li, lj = idx // vals.shape[1], idx % vals.shape[1]
+    return flat[idx], (I[li], J[lj])
+
+
+class _MaxRed:
+    pad = -jnp.inf
+    arg = staticmethod(jnp.argmax)
+
+
+class _MinRed:
+    pad = jnp.inf
+    arg = staticmethod(jnp.argmin)
+
+
+def max_abs_loc(A: DistMatrix):
+    """(|a_ij|max, (i,j)) -- ``El::MaxAbsLoc``; the LU pivot-search kernel."""
+    return _loc_reduce(A, jnp.abs(A.local), _MaxRed)
+
+
+def min_abs_loc(A: DistMatrix):
+    """``El::MinAbsLoc``."""
+    return _loc_reduce(A, jnp.abs(A.local), _MinRed)
+
+
+def max_loc(A: DistMatrix):
+    """``El::MaxLoc`` (real dtypes)."""
+    return _loc_reduce(A, jnp.real(A.local), _MaxRed)
+
+
+def min_loc(A: DistMatrix):
+    """``El::MinLoc`` (real dtypes)."""
+    return _loc_reduce(A, jnp.real(A.local), _MinRed)
+
+
+# ---- trapezoid updates (ScaleTrapezoid.cpp, AxpyTrapezoid.cpp) -------
+
+def _trapezoid_mask(A: DistMatrix, uplo: str, offset: int):
+    I, J = _global_indices(A)
+    if uplo.upper().startswith("L"):
+        return J[None, :] <= I[:, None] + offset
+    return J[None, :] >= I[:, None] + offset
+
+
+def scale_trapezoid(alpha, A: DistMatrix, uplo: str, offset: int = 0
+                    ) -> DistMatrix:
+    """Scale the lower/upper trapezoid by alpha, rest untouched
+    (``El::ScaleTrapezoid``)."""
+    keep = _trapezoid_mask(A, uplo, offset)
+    return A.with_local(jnp.where(keep, alpha * A.local, A.local))
+
+
+def axpy_trapezoid(alpha, X: DistMatrix, Y: DistMatrix, uplo: str,
+                   offset: int = 0) -> DistMatrix:
+    """Y += alpha * trapezoid(X) (``El::AxpyTrapezoid``)."""
+    _check_same_layout(X, Y)
+    keep = _trapezoid_mask(X, uplo, offset)
+    return Y.with_local(Y.local + jnp.where(keep, alpha * X.local, 0))
+
+
+def safe_scale(numerator, denominator, A: DistMatrix):
+    """A := (numerator/denominator) A staged to avoid overflow/underflow
+    (``El::SafeScale``; the LAPACK ``dlascl`` multiplier-staging loop)."""
+    import numpy as _np
+    base = A.local.real.dtype if jnp.iscomplexobj(A.local) else A.local.dtype
+    fin = _np.finfo(base)
+    small, big = float(fin.tiny), 1.0 / float(fin.tiny)
+    cfrom, cto = float(denominator), float(numerator)
+    out = A
+    while True:
+        cfrom1 = cfrom * small
+        cto1 = cto / big
+        if abs(cfrom1) > abs(cto) and cto != 0.0:
+            mul, cfrom = small, cfrom1
+        elif abs(cto1) > abs(cfrom):
+            mul, cto = big, cto1
+        else:
+            return out.with_local(out.local * (cto / cfrom))
+        out = out.with_local(out.local * mul)
+
+
+# ---- submatrix access (GetSubmatrix.cpp / SetSubmatrix.cpp) ----------
+
+def get_submatrix(A: DistMatrix, i0: int, j0: int, m: int, n: int
+                  ) -> DistMatrix:
+    """Copy out A[i0:i0+m, j0:j0+n] as a zero-aligned matrix of the same
+    distribution (``El::GetSubmatrix`` with contiguous ranges)."""
+    from ..redist.interior import interior_view
+    return interior_view(A, (i0, i0 + m), (j0, j0 + n))
+
+
+def set_submatrix(A: DistMatrix, i0: int, j0: int, B: DistMatrix
+                  ) -> DistMatrix:
+    """Write B into A[i0:.., j0:..] (``El::SetSubmatrix``)."""
+    from ..redist.interior import interior_update
+    return interior_update(A, B, at=(i0, j0))

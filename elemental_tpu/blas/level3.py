@@ -36,9 +36,6 @@ from ..redist.engine import to_dist, redistribute, transpose_dist
 from .level1 import _global_indices
 
 
-DEFAULT_BLOCK = 128
-
-
 def _check_mcmr(*Ms: DistMatrix):
     g = Ms[0].grid
     for A in Ms:
@@ -49,7 +46,9 @@ def _check_mcmr(*Ms: DistMatrix):
 
 
 def _blocksize(nb: int | None, grain: int, extent: int) -> int:
-    nb = DEFAULT_BLOCK if nb is None else nb
+    if nb is None:
+        from ..core.environment import blocksize
+        nb = blocksize()
     nb = round_up(max(nb, 1), grain)
     return min(nb, round_up(max(extent, 1), grain))
 

@@ -26,10 +26,6 @@ from .cholesky import cholesky, cholesky_solve_after
 from .ldl import ldl, ldl_solve_after
 
 
-def _tp(A):
-    return redistribute(transpose_dist(A), MC, MR)
-
-
 def ridge(A: DistMatrix, b: DistMatrix, gamma: float,
           nb: int | None = None, precision=None) -> DistMatrix:
     """min ||A x - b||^2 + gamma^2 ||x||^2 (``El::Ridge``): the stacked

@@ -217,17 +217,23 @@ def socp(A: DistMatrix, b: DistMatrix, c: DistMatrix, orders_list,
             break
         score = max(abs(rel_gap), pfeas, dfeas)
         if not np.isfinite(mu) or rel_gap < 0:
-            # boundary breakdown: return the best iterate seen
+            # boundary breakdown: return the best iterate seen, with info
+            # recomputed to describe THAT iterate (not the broken one)
             _, xv, yv, zv = best
             info["stalled"] = True
-            info.update(rel_gap=best[0])
+            gap = float(xv @ zv)
+            pobj = float(cn @ xv)
+            info.update(mu=gap / K, pobj=pobj,
+                        rel_gap=gap / (1.0 + abs(pobj)),
+                        pfeas=np.linalg.norm(An @ xv - bn) / nb_,
+                        dfeas=np.linalg.norm(cn - An.T @ yv - zv) / nc_)
             break
         if score < best[0]:
             best = (score, xv.copy(), yv.copy(), zv.copy())
 
         # NT scaling: H = Q_w maps z to x; the Newton system linearizes
         # complementarity as dx + H dz = rcomb, giving the augmented KKT
-        #   [ -H^{-1}  A^T ] [dx]   [ H^{-1} rcomb - rc ]
+        #   [ -H^{-1}  A^T ] [dx]   [ rc - H^{-1} rcomb ]
         #   [    A      0  ] [dy] = [       -rb         ]
         # with dz = H^{-1}(rcomb - dx); H^{-1} = Q_{w^-1} in closed form.
         w = soc_nesterov_todd(xv, zv, first_inds)

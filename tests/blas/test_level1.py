@@ -1,0 +1,161 @@
+"""Level-1 zoo oracle tests.
+
+Reference analog: the reference exercises level-1 through every driver; the
+conformance style here is entry-for-entry agreement with the numpy oracle
+on the gathered global matrix, swept over distributions where layout
+matters.
+"""
+import numpy as np
+import pytest
+
+import elemental_tpu as el
+from elemental_tpu.blas import level1 as l1
+
+
+def _mk(grid, m=13, n=9, dtype=np.float64, seed=0, dist=None):
+    rng = np.random.default_rng(seed)
+    if np.issubdtype(dtype, np.complexfloating):
+        G = (rng.normal(size=(m, n)) + 1j * rng.normal(size=(m, n))).astype(dtype)
+    else:
+        G = rng.normal(size=(m, n)).astype(dtype)
+    d = dist or (el.MC, el.MR)
+    return G, el.from_global(G, *d, grid=grid)
+
+
+def _g(A):
+    return np.asarray(el.to_global(A))
+
+
+class TestElementwise:
+    def test_axpy_scale_hadamard(self, grid24):
+        X, Xd = _mk(grid24, seed=1)
+        Y, Yd = _mk(grid24, seed=2)
+        np.testing.assert_allclose(_g(l1.axpy(2.5, Xd, Yd)), 2.5 * X + Y)
+        np.testing.assert_allclose(_g(l1.scale(-3.0, Xd)), -3.0 * X)
+        np.testing.assert_allclose(_g(l1.hadamard(Xd, Yd)), X * Y)
+
+    def test_fill_and_entrywise(self, grid24):
+        X, Xd = _mk(grid24)
+        np.testing.assert_allclose(_g(l1.fill(Xd, 7.0)), np.full(X.shape, 7.0))
+        np.testing.assert_allclose(_g(l1.entrywise_map(Xd, lambda a: a ** 3)),
+                                   X ** 3)
+
+    def test_round_swap_parts(self, grid24):
+        X, Xd = _mk(grid24, dtype=np.complex128)
+        np.testing.assert_allclose(_g(l1.real_part(Xd)), X.real)
+        np.testing.assert_allclose(_g(l1.imag_part(Xd)), X.imag)
+        R = _g(l1.round_entries(Xd))
+        np.testing.assert_allclose(R, np.round(X.real) + 1j * np.round(X.imag))
+        Y, Yd = _mk(grid24, dtype=np.complex128, seed=5)
+        A2, B2 = l1.swap(Xd, Yd)
+        np.testing.assert_allclose(_g(A2), Y)
+        np.testing.assert_allclose(_g(B2), X)
+
+
+class TestOrientation:
+    @pytest.mark.parametrize("dist", [(el.MC, el.MR), (el.MR, el.MC),
+                                      (el.VC, el.STAR)],
+                             ids=["mcmr", "mrmc", "vcstar"])
+    def test_transpose_adjoint(self, grid24, dist):
+        X, Xd = _mk(grid24, dtype=np.complex128, dist=dist)
+        T = l1.transpose(Xd)
+        assert T.dist == Xd.dist and T.gshape == (9, 13)
+        np.testing.assert_allclose(_g(T), X.T)
+        np.testing.assert_allclose(_g(l1.adjoint(Xd)), X.conj().T)
+
+
+class TestLocReductions:
+    def test_max_abs_loc(self, any_grid):
+        X, Xd = _mk(any_grid, seed=3)
+        v, (i, j) = l1.max_abs_loc(Xd)
+        fi, fj = np.unravel_index(np.argmax(np.abs(X)), X.shape)
+        assert (int(i), int(j)) == (fi, fj)
+        np.testing.assert_allclose(float(v), np.abs(X).max())
+
+    def test_min_abs_and_minmax_loc(self, grid24):
+        X, Xd = _mk(grid24, seed=4)
+        v, (i, j) = l1.min_abs_loc(Xd)
+        fi, fj = np.unravel_index(np.argmin(np.abs(X)), X.shape)
+        assert (int(i), int(j)) == (fi, fj)
+        v, (i, j) = l1.max_loc(Xd)
+        fi, fj = np.unravel_index(np.argmax(X), X.shape)
+        assert (int(i), int(j)) == (fi, fj)
+        v, (i, j) = l1.min_loc(Xd)
+        fi, fj = np.unravel_index(np.argmin(X), X.shape)
+        assert (int(i), int(j)) == (fi, fj)
+
+    def test_norms_and_dots(self, grid24):
+        X, Xd = _mk(grid24, dtype=np.complex128, seed=6)
+        Y, Yd = _mk(grid24, dtype=np.complex128, seed=7)
+        np.testing.assert_allclose(float(l1.frobenius_norm(Xd)),
+                                   np.linalg.norm(X))
+        np.testing.assert_allclose(float(l1.one_norm(Xd)),
+                                   np.abs(X).sum(0).max())
+        np.testing.assert_allclose(float(l1.infinity_norm(Xd)),
+                                   np.abs(X).sum(1).max())
+        np.testing.assert_allclose(float(l1.max_norm(Xd)), np.abs(X).max())
+        np.testing.assert_allclose(complex(l1.dot(Xd, Yd)),
+                                   np.sum(X.conj() * Y))
+        np.testing.assert_allclose(complex(l1.dotu(Xd, Yd)), np.sum(X * Y))
+
+
+class TestTrapezoid:
+    @pytest.mark.parametrize("uplo,off", [("L", 0), ("U", 0), ("L", -2),
+                                          ("U", 3)])
+    def test_make_scale_axpy(self, grid24, uplo, off):
+        X, Xd = _mk(grid24, m=11, n=11, seed=8)
+        Y, Yd = _mk(grid24, m=11, n=11, seed=9)
+        tri = np.tril(X, off) if uplo == "L" else np.triu(X, off)
+        np.testing.assert_allclose(_g(l1.make_trapezoidal(Xd, uplo, off)), tri)
+        exp = np.where(tri != 0, 2.0 * X, X)
+        np.testing.assert_allclose(_g(l1.scale_trapezoid(2.0, Xd, uplo, off)),
+                                   exp)
+        np.testing.assert_allclose(_g(l1.axpy_trapezoid(3.0, Xd, Yd, uplo, off)),
+                                   Y + 3.0 * tri)
+
+    def test_safe_scale_extreme(self, grid24):
+        X, Xd = _mk(grid24, seed=10)
+        out = l1.safe_scale(1e-300, 1e-10, Xd)      # ratio 1e-290: stages
+        np.testing.assert_allclose(_g(out), X * 1e-290, rtol=1e-12)
+        out = l1.safe_scale(3.0, 2.0, Xd)
+        np.testing.assert_allclose(_g(out), X * 1.5)
+
+
+class TestDiagonal:
+    def test_get_set_update(self, grid24):
+        X, Xd = _mk(grid24, m=10, n=10, seed=11)
+        d = l1.get_diagonal(Xd)
+        np.testing.assert_allclose(np.asarray(el.to_global(d)).ravel(),
+                                   np.diag(X))
+        dnew = el.from_global(np.arange(10.0).reshape(10, 1),
+                              el.STAR, el.STAR, grid=grid24)
+        S = l1.set_diagonal(Xd, dnew)
+        exp = X.copy(); np.fill_diagonal(exp, np.arange(10.0))
+        np.testing.assert_allclose(_g(S), exp)
+        U = l1.update_diagonal(Xd, dnew)
+        exp = X + np.diag(np.arange(10.0))
+        np.testing.assert_allclose(_g(U), exp)
+
+    def test_diagonal_scale_solve(self, grid24):
+        X, Xd = _mk(grid24, m=8, n=5, seed=12)
+        dv = np.arange(1.0, 9.0).reshape(8, 1)
+        dd = el.from_global(dv, el.STAR, el.STAR, grid=grid24)
+        np.testing.assert_allclose(_g(l1.diagonal_scale("L", dd, Xd)),
+                                   dv * X)
+        np.testing.assert_allclose(_g(l1.diagonal_solve("L", dd, Xd)),
+                                   X / dv)
+        dr = np.arange(1.0, 6.0).reshape(5, 1)
+        ddr = el.from_global(dr, el.STAR, el.STAR, grid=grid24)
+        np.testing.assert_allclose(_g(l1.diagonal_scale("R", ddr, Xd)),
+                                   X * dr.T)
+
+
+class TestSubmatrix:
+    def test_get_set_roundtrip(self, grid24):
+        X, Xd = _mk(grid24, m=12, n=10, seed=13)
+        S = l1.get_submatrix(Xd, 3, 2, 6, 5)
+        np.testing.assert_allclose(_g(S), X[3:9, 2:7])
+        B = el.from_global(np.ones((6, 5)), el.MC, el.MR, grid=grid24)
+        W = l1.set_submatrix(Xd, 3, 2, B)
+        exp = X.copy(); exp[3:9, 2:7] = 1.0
+        np.testing.assert_allclose(_g(W), exp)
