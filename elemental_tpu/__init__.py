@@ -13,6 +13,10 @@ from .core.ctrl import (SignCtrl, PolarCtrl, HermitianEigCtrl, SVDCtrl,
                         SchurCtrl, PseudospecCtrl, LDLPivotCtrl, QRCtrl,
                         LeastSquaresCtrl)
 from .core.distmatrix import DistMatrix, from_global, to_global, zeros
+from .core.multivec import (DistMultiVec, mv_from_global, mv_to_global,
+                            mv_zeros, mv_axpy, mv_scale, mv_dot, mv_nrm2,
+                            mv_remote_updates, mv_to_distmatrix,
+                            mv_from_distmatrix)
 from .redist.engine import redistribute, transpose_dist
 
 __version__ = "0.2.0"
@@ -50,3 +54,7 @@ from .lapack.props import (determinant, safe_determinant, hpd_determinant,
                            two_norm_estimate, condition, nuclear_norm,
                            schatten_norm, two_norm)
 from .io import print_matrix, write_matrix, read_matrix, checkpoint, restore
+from . import sparse
+from .sparse import (Graph, DistGraph, SparseMatrix, DistSparseMatrix,
+                     DistMap, sparse_from_coo, dist_sparse_from_coo,
+                     cg, cgls, gmres)

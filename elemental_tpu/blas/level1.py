@@ -361,6 +361,8 @@ def safe_scale(numerator, denominator, A: DistMatrix):
     fin = _np.finfo(base)
     small, big = float(fin.tiny), 1.0 / float(fin.tiny)
     cfrom, cto = float(denominator), float(numerator)
+    if cfrom == 0.0:
+        raise ValueError("safe_scale: denominator must be nonzero")
     out = A
     while True:
         cfrom1 = cfrom * small

@@ -201,7 +201,6 @@ class Args:
         if missing:
             self.print_report()
             raise ValueError(f"missing required flags: {missing}")
-        known = set(self._flags)
         i = 0
         while i < len(self.argv):
             tok = self.argv[i]
@@ -209,10 +208,17 @@ class Args:
                 if tok == "--help":
                     self.print_report()
                     raise SystemExit(0)
-                if tok not in known:
+                flag = self._flags.get(tok)
+                if flag is None:
                     raise ValueError(f"unknown flag {tok}")
                 nxt = self.argv[i + 1] if i + 1 < len(self.argv) else None
-                if nxt is not None and not nxt.startswith("--"):
+                # skip exactly the tokens _parse consumed: non-bool flags
+                # always consume the next token; bool flags consume it only
+                # when it is a value, not another flag
+                if flag.type is not bool:
+                    if nxt is not None:
+                        i += 1
+                elif nxt is not None and not nxt.startswith("--"):
                     i += 1
             i += 1
         if report:

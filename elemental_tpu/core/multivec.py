@@ -1,0 +1,175 @@
+"""DistMultiVec: tall-skinny dense matrix with contiguous row-block layout.
+
+Reference: ``El::DistMultiVec<T>`` (``include/El/core/DistMultiVec/``,
+``src/core/DistMultiVec.cpp``): rows distributed in CONTIGUOUS blocks (not
+cyclic) over all p ranks; the operand type of the sparse solvers and IPMs,
+with queued ``RemoteUpdate`` batched writes.
+
+TPU-native design: contiguous row-block IS XLA's natural tiled sharding,
+so the leaf is simply the global array zero-padded to ``p * ceil(m/p)``
+rows and device_put with ``PartitionSpec(('mc','mr'), None)`` -- device d
+owns padded-global rows [d*blk, (d+1)*blk).  Because blocks are contiguous
+and uniform, storage row index == global row index (padding lives at the
+tail), so host bridges are slices, elementwise ops and reductions run
+directly on the leaf (padding zero), and batched remote updates are one
+``.at[].add``.  ``shard_map`` kernels (the sparse layer) see the (blk, n)
+local block with spec ``P(('mc','mr'), None)``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .grid import Grid, default_grid
+
+
+def _blk(m: int, p: int) -> int:
+    return -(-max(m, 1) // p)
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["local"],
+    meta_fields=["gshape", "grid"],
+)
+@dataclasses.dataclass(frozen=True)
+class DistMultiVec:
+    local: Any        # (p*blk, width) zero-padded global array, row-sharded
+    gshape: tuple     # true (m, width)
+    grid: Grid
+
+    @property
+    def block(self) -> int:
+        """Rows owned per device (uniform, padded)."""
+        return _blk(self.gshape[0], self.grid.size)
+
+    @property
+    def spec(self) -> P:
+        return P(("mc", "mr"), None)
+
+    @property
+    def dtype(self):
+        return self.local.dtype
+
+    @property
+    def width(self) -> int:
+        return self.gshape[1]
+
+    def row_owner(self, i: int) -> int:
+        """Rank owning global row i (``DistMultiVec::RowOwner``)."""
+        return i // self.block
+
+    def with_local(self, local) -> "DistMultiVec":
+        return dataclasses.replace(self, local=local)
+
+    def __repr__(self):
+        return (f"DistMultiVec(gshape={self.gshape}, grid={self.grid}, "
+                f"dtype={self.local.dtype})")
+
+
+def mv_from_global(arr, grid: Grid | None = None,
+                   device_put: bool = True) -> DistMultiVec:
+    """Build from a replicated (m, width) array (pad tail rows to p*blk)."""
+    grid = grid or default_grid()
+    arr = jnp.asarray(arr)
+    if arr.ndim == 1:
+        arr = arr.reshape(-1, 1)
+    m, w = arr.shape
+    blk = _blk(m, grid.size)
+    stor = jnp.zeros((grid.size * blk, w), arr.dtype).at[:m].set(arr)
+    mv = DistMultiVec(stor, (m, w), grid)
+    if device_put:
+        mv = mv.with_local(jax.device_put(stor, grid.sharding(mv.spec)))
+    return mv
+
+
+def mv_to_global(v: DistMultiVec):
+    """Recover the (m, width) array (drop tail padding)."""
+    return v.local[: v.gshape[0]]
+
+
+def mv_zeros(m: int, width: int = 1, grid: Grid | None = None,
+             dtype=jnp.float32) -> DistMultiVec:
+    grid = grid or default_grid()
+    blk = _blk(m, grid.size)
+    mv = DistMultiVec(None, (m, width), grid)
+    stor = jnp.zeros((grid.size * blk, width), dtype)
+    return mv.with_local(jax.device_put(stor, grid.sharding(mv.spec)))
+
+
+# ---- elementwise / reductions (padding-oblivious on the padded leaf) ----
+
+def mv_axpy(alpha, X: DistMultiVec, Y: DistMultiVec) -> DistMultiVec:
+    _check_same(X, Y)
+    return Y.with_local(alpha * X.local + Y.local)
+
+
+def mv_scale(alpha, X: DistMultiVec) -> DistMultiVec:
+    return X.with_local(alpha * X.local)
+
+
+def mv_dot(X: DistMultiVec, Y: DistMultiVec):
+    """<X, Y> = sum conj(X) * Y (tail padding is zero on both sides)."""
+    _check_same(X, Y)
+    return jnp.sum(jnp.conj(X.local) * Y.local)
+
+
+def mv_nrm2(X: DistMultiVec):
+    return jnp.linalg.norm(X.local)
+
+
+def _check_same(X: DistMultiVec, Y: DistMultiVec):
+    if X.gshape != Y.gshape or X.grid != Y.grid:
+        raise ValueError(f"DistMultiVec mismatch: {X} vs {Y}")
+
+
+# ---- batched remote updates (Reserve/QueueUpdate/ProcessQueues) ------
+
+def mv_remote_updates(v: DistMultiVec, rows, cols, vals) -> DistMultiVec:
+    """Apply a batch of ``v[rows[k], cols[k]] += vals[k]`` updates.
+
+    The analog of the reference's queued ``RemoteUpdate`` +
+    ``ProcessQueues``: callers batch arbitrary (possibly duplicate) global
+    updates; one scatter-add lands them, XLA routing the cross-device
+    writes (the all-to-all the reference does by hand).  Indices are
+    validated host-side when concrete (the queue API is a host-side build
+    phase; writes into the zero-padding tail would corrupt every
+    padding-oblivious reduction)."""
+    import numpy as _np
+    m, w = v.gshape
+    try:
+        ri = _np.asarray(rows)
+        ci = _np.asarray(cols)
+    except Exception:
+        ri = ci = None              # traced: caller guarantees bounds
+    if ri is not None and ri.size and (
+            ri.min() < 0 or ri.max() >= m or ci.min() < 0 or ci.max() >= w):
+        raise ValueError(f"remote update out of bounds for gshape {v.gshape}")
+    rows = jnp.asarray(rows)
+    cols = jnp.asarray(cols)
+    vals = jnp.asarray(vals, v.dtype)
+    return v.with_local(v.local.at[rows, cols].add(vals))
+
+
+# ---- bridges to DistMatrix (API edge) --------------------------------
+
+def mv_to_distmatrix(v: DistMultiVec, cdist=None, rdist=None):
+    """Convert to a [MC,MR] (default) DistMatrix via the global bridge.
+
+    API-edge op (the reference's DistMultiVec <-> DistMatrix copies also
+    funnel through gather/scatter); the sparse/IPM hot paths never call it."""
+    from .dist import MC, MR
+    from .distmatrix import from_global
+    cdist = MC if cdist is None else cdist
+    rdist = MR if rdist is None else rdist
+    return from_global(mv_to_global(v), cdist, rdist, grid=v.grid)
+
+
+def mv_from_distmatrix(A) -> DistMultiVec:
+    from .distmatrix import to_global
+    return mv_from_global(to_global(A), grid=A.grid)

@@ -1,0 +1,12 @@
+"""Sparse core + iterative solvers.
+
+Reference: Elemental's sparse layer (``include/El/core/{Graph, DistGraph,
+SparseMatrix, DistSparseMatrix, DistMap}/``) and the iterative pieces of
+``reg_ldl``/``LeastSquares``.  The reference's sparse-DIRECT multifrontal
+factorization (METIS nested dissection) is consciously out of scope
+(SURVEY.md §3.7 item 4, §8.3 item 6); the TPU-native sparse story is
+static-shape COO kernels under ``shard_map`` + matmul-free Krylov solvers.
+"""
+from .core import (Graph, DistGraph, SparseMatrix, DistSparseMatrix,
+                   DistMap, sparse_from_coo, dist_sparse_from_coo)
+from .solvers import cg, cgls, gmres

@@ -1,0 +1,336 @@
+"""Sparse matrices: queue-built COO, row-block distributed, shard_map SpMV.
+
+Reference: ``El::Graph``/``DistGraph`` (1-D row-distributed adjacency),
+``El::SparseMatrix<T>``/``DistSparseMatrix<T>`` (CSR built via
+``Reserve``+``QueueUpdate``+``ProcessQueues``), ``El::DistMap``
+(distributed permutation) -- ``include/El/core/*``.
+
+TPU-native design decisions:
+
+* **Build = host-side queues, freeze = device arrays.** The reference's
+  QueueUpdate/ProcessQueues idiom maps to a Python builder phase followed
+  by ``freeze()``; the frozen matrix is a pytree whose leaves are the
+  (p, k) per-device triplet arrays, so the nonzero COUNT is static but the
+  structure and values are device data -- one jitted SpMV serves every
+  matrix of the same (shape, k), and ``with_values`` re-uses a frozen
+  structure with new numbers (the IPM re-factorization pattern).
+* **COO, not CSR.** TPU has no CSR advantage; a row-block-partitioned COO
+  triplet list feeds one scatter-add -- the whole SpMV is two VPU gathers
+  and one scatter on each device.
+* **Row-block ownership over the flat mesh**, matching ``DistMultiVec``:
+  device d owns rows [d*blk, (d+1)*blk); its triplets are padded to the
+  max per-device count k with val=0 no-ops, giving the uniform (p, k)
+  stacked arrays ``shard_map`` needs.
+* SpMV: x is gathered replicated (``all_gather`` over both axes -- the
+  reference's ``DistSparseMatrix::Multiply`` likewise exchanges the
+  needed x entries); y comes back row-block.  Adjoint SpMV scatter-adds
+  into a replicated accumulator and ``psum``s.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.grid import Grid, default_grid
+from ..core.multivec import DistMultiVec, _blk
+from ..core.dist import MC, MR
+
+
+# ---------------------------------------------------------------------
+# Graph / DistGraph (structure-only; El::Graph, El::DistGraph)
+# ---------------------------------------------------------------------
+
+class Graph:
+    """Adjacency structure built by queued edge insertion.
+
+    ``El::Graph``: ``QueueConnection(s, t)`` + ``ProcessQueues``; here the
+    frozen form is the sorted, deduplicated (sources, targets) pair."""
+
+    def __init__(self, num_sources: int, num_targets: int | None = None):
+        self.num_sources = num_sources
+        self.num_targets = num_sources if num_targets is None else num_targets
+        self._q: list[tuple[int, int]] = []
+        self._frozen = None
+
+    def queue_connection(self, s: int, t: int) -> None:
+        if not (0 <= s < self.num_sources and 0 <= t < self.num_targets):
+            raise ValueError(f"edge ({s},{t}) out of bounds")
+        self._q.append((s, t))
+        self._frozen = None
+
+    def process_queues(self):
+        """Sort + dedup; returns (sources, targets) int arrays."""
+        if self._frozen is None:
+            if self._q:
+                st = np.unique(np.asarray(self._q, np.int64), axis=0)
+            else:
+                st = np.zeros((0, 2), np.int64)
+            self._frozen = (st[:, 0].copy(), st[:, 1].copy())
+        return self._frozen
+
+    @property
+    def num_edges(self) -> int:
+        return len(self.process_queues()[0])
+
+
+class DistGraph(Graph):
+    """Row-block distributed adjacency (``El::DistGraph``): same build API;
+    the partition is implied by the owning ``DistSparseMatrix``."""
+
+    def __init__(self, num_sources: int, num_targets: int | None = None,
+                 grid: Grid | None = None):
+        super().__init__(num_sources, num_targets)
+        self.grid = grid or default_grid()
+
+
+# ---------------------------------------------------------------------
+# DistMap (El::DistMap): distributed permutation for reorderings
+# ---------------------------------------------------------------------
+
+class DistMap:
+    """A permutation of [0, n) applied to DistMultiVec rows.
+
+    ``El::DistMap`` stores the image distributed; here the image vector is
+    replicated metadata (n host ints) and application is one row-gather on
+    the padded leaf -- XLA shards the take."""
+
+    def __init__(self, image, grid: Grid | None = None):
+        self.image = np.asarray(image, np.int64)
+        n = self.image.shape[0]
+        if sorted(self.image.tolist()) != list(range(n)):
+            raise ValueError("DistMap image is not a permutation")
+        self.grid = grid or default_grid()
+
+    @property
+    def size(self) -> int:
+        return self.image.shape[0]
+
+    def inverse(self) -> "DistMap":
+        inv = np.empty_like(self.image)
+        inv[self.image] = np.arange(self.size)
+        return DistMap(inv, self.grid)
+
+    def translate(self, v: DistMultiVec) -> DistMultiVec:
+        """w[image[i]] = v[i] (``DistMap::Translate``)."""
+        m = v.gshape[0]
+        if m != self.size:
+            raise ValueError(f"DistMap size {self.size} vs vector rows {m}")
+        inv = self.inverse().image
+        pad = v.local.shape[0] - m
+        idx = jnp.concatenate([jnp.asarray(inv),
+                               jnp.arange(m, m + pad)])
+        return v.with_local(jnp.take(v.local, idx, axis=0))
+
+
+# ---------------------------------------------------------------------
+# DistSparseMatrix: frozen (p, k) row-block COO
+# ---------------------------------------------------------------------
+
+_ROWSPEC = P(("mc", "mr"), None)
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["vals", "rows_loc", "cols"],
+    meta_fields=["gshape", "nnz", "grid"],
+)
+@dataclasses.dataclass(frozen=True)
+class DistSparseMatrix:
+    """Frozen row-block COO matrix (leaves = per-device triplet arrays).
+
+    ``rows_loc``: (p, k) int32 LOCAL row offsets (global row = d*blk +
+    rows_loc[d]); ``cols``: (p, k) int32 global column ids; ``vals``:
+    (p, k) values.  All three sharded row-block over the flat mesh;
+    padding entries are (0, 0, 0) no-ops.  ``nnz``/``gshape``/``grid`` are
+    static, so one jit specialization covers every matrix with the same
+    shape and per-device budget k.
+    """
+    vals: Any
+    rows_loc: Any
+    cols: Any
+    gshape: tuple
+    nnz: int
+    grid: Grid
+
+    @property
+    def dtype(self):
+        return self.vals.dtype
+
+    def with_values(self, vals) -> "DistSparseMatrix":
+        """New numbers on the same frozen structure (IPM refactor path)."""
+        return dataclasses.replace(self, vals=vals)
+
+    def __repr__(self):
+        return (f"DistSparseMatrix(gshape={self.gshape}, nnz={self.nnz}, "
+                f"grid={self.grid})")
+
+    # ---- SpMV --------------------------------------------------------
+
+    def spmv(self, x: DistMultiVec, alpha=1.0) -> DistMultiVec:
+        """y = alpha * A x (``El::Multiply(NORMAL, ...)``)."""
+        if x.gshape[0] != self.gshape[1]:
+            raise ValueError(f"A is {self.gshape}, x has {x.gshape[0]} rows")
+        return _spmv(self, x, jnp.asarray(alpha, self.vals.dtype))
+
+    def spmv_adjoint(self, x: DistMultiVec, alpha=1.0) -> DistMultiVec:
+        """y = alpha * A^H x (``El::Multiply(ADJOINT, ...)``)."""
+        if x.gshape[0] != self.gshape[0]:
+            raise ValueError(f"A^H needs {self.gshape[0]} rows, "
+                             f"x has {x.gshape[0]}")
+        return _spmv_adjoint(self, x, jnp.asarray(alpha, self.vals.dtype))
+
+    # ---- bridges -----------------------------------------------------
+
+    def to_dense(self):
+        """Materialize as a [MC,MR] DistMatrix (small problems / tests)."""
+        from ..core.distmatrix import from_global
+        m, n = self.gshape
+        blk = _blk(m, self.grid.size)
+        rl = np.asarray(self.rows_loc)
+        p, k = rl.shape
+        rg = rl + blk * np.arange(p)[:, None]
+        dense = np.zeros((m, n), np.asarray(self.vals).dtype)
+        np.add.at(dense, (np.minimum(rg, m - 1).reshape(-1),
+                          np.asarray(self.cols).reshape(-1)),
+                  np.asarray(self.vals).reshape(-1))
+        return from_global(dense, MC, MR, grid=self.grid)
+
+
+@jax.jit
+def _spmv(A: DistSparseMatrix, x: DistMultiVec, alpha) -> DistMultiVec:
+    m, n = A.gshape
+    g = A.grid
+    w = x.width
+    blk_m = _blk(m, g.size)
+    out_meta = DistMultiVec(None, (m, w), g)
+
+    def f(vals, rows_l, cols_g, xloc):
+        xf = lax.all_gather(xloc, ("mc", "mr"), tiled=True)    # (n_pad, w)
+        contrib = vals.reshape(-1, 1) * jnp.take(xf, cols_g.reshape(-1),
+                                                 axis=0)       # (k, w)
+        y = jnp.zeros((blk_m, w), contrib.dtype).at[
+            rows_l.reshape(-1)].add(contrib)
+        return alpha * y
+
+    y = jax.shard_map(
+        f, mesh=g.mesh,
+        in_specs=(_ROWSPEC, _ROWSPEC, _ROWSPEC, x.spec),
+        out_specs=out_meta.spec, check_vma=False,
+    )(A.vals, A.rows_loc, A.cols, x.local)
+    return out_meta.with_local(y)
+
+
+@jax.jit
+def _spmv_adjoint(A: DistSparseMatrix, x: DistMultiVec, alpha) -> DistMultiVec:
+    m, n = A.gshape
+    g = A.grid
+    w = x.width
+    blk_n = _blk(n, g.size)
+    out_meta = DistMultiVec(None, (n, w), g)
+
+    def f(vals, rows_l, cols_g, xloc):
+        # this device's triplets hit ITS OWN x rows (row-block match)
+        contrib = jnp.conj(vals.reshape(-1, 1)) * jnp.take(
+            xloc, rows_l.reshape(-1), axis=0)                  # (k, w)
+        yfull = jnp.zeros((g.size * blk_n, w), contrib.dtype).at[
+            cols_g.reshape(-1)].add(contrib)
+        yfull = lax.psum(yfull, ("mc", "mr"))
+        me = lax.axis_index("mc") * g.width + lax.axis_index("mr")
+        return alpha * lax.dynamic_slice_in_dim(yfull, me * blk_n, blk_n)
+
+    y = jax.shard_map(
+        f, mesh=g.mesh,
+        in_specs=(_ROWSPEC, _ROWSPEC, _ROWSPEC, x.spec),
+        out_specs=out_meta.spec, check_vma=False,
+    )(A.vals, A.rows_loc, A.cols, x.local)
+    return out_meta.with_local(y)
+
+
+# ---------------------------------------------------------------------
+# Builders
+# ---------------------------------------------------------------------
+
+class SparseMatrix:
+    """Sequential/queue-building front end (``El::SparseMatrix``).
+
+    ``queue_update(i, j, v)`` batches entries (duplicates sum);
+    ``freeze(grid)`` coalesces and returns the immutable
+    ``DistSparseMatrix`` (1x1 grid => sequential semantics)."""
+
+    def __init__(self, m: int, n: int | None = None):
+        self.m = m
+        self.n = m if n is None else n
+        self._i: list[int] = []
+        self._j: list[int] = []
+        self._v: list[float] = []
+
+    def queue_update(self, i: int, j: int, v) -> None:
+        if not (0 <= i < self.m and 0 <= j < self.n):
+            raise ValueError(f"entry ({i},{j}) out of bounds "
+                             f"for {self.m}x{self.n}")
+        self._i.append(i); self._j.append(j); self._v.append(v)
+
+    def freeze(self, grid: Grid | None = None, dtype=None) -> DistSparseMatrix:
+        return dist_sparse_from_coo(self._i, self._j, self._v,
+                                    self.m, self.n, grid=grid, dtype=dtype)
+
+
+def sparse_from_coo(rows, cols, vals, m: int, n: int,
+                    dtype=None) -> DistSparseMatrix:
+    """COO -> frozen sparse matrix on the default grid."""
+    return dist_sparse_from_coo(rows, cols, vals, m, n, dtype=dtype)
+
+
+def dist_sparse_from_coo(rows, cols, vals, m: int, n: int,
+                         grid: Grid | None = None, dtype=None,
+                         pad_to: int | None = None) -> DistSparseMatrix:
+    """Coalesce (sum duplicates), partition by row-block owner, pad each
+    device's triplet list to the max count (or ``pad_to``, to share one
+    jit specialization across matrices), freeze to device arrays."""
+    grid = grid or default_grid()
+    p = grid.size
+    rows = np.asarray(rows, np.int64).reshape(-1)
+    cols = np.asarray(cols, np.int64).reshape(-1)
+    vals = np.asarray(vals).reshape(-1)
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    if rows.size:
+        if rows.min() < 0 or rows.max() >= m or cols.min() < 0 \
+                or cols.max() >= n:
+            raise ValueError("COO indices out of bounds")
+        key = rows * n + cols
+        order = np.argsort(key, kind="stable")
+        key, vals = key[order], vals[order]
+        uniq, start = np.unique(key, return_index=True)
+        vals = np.add.reduceat(vals, start)
+        rows, cols = uniq // n, uniq % n
+    nnz = rows.size
+    blk = _blk(m, p)
+    owner = rows // blk
+    k = max(int(np.bincount(owner, minlength=p).max()) if nnz else 0, 1)
+    if pad_to is not None:
+        if pad_to < k:
+            raise ValueError(f"pad_to={pad_to} < required per-device {k}")
+        k = pad_to
+    R = np.zeros((p, k), np.int32)
+    C = np.zeros((p, k), np.int32)
+    V = np.zeros((p, k), vals.dtype)
+    for d in range(p):
+        sel = owner == d
+        cnt = int(sel.sum())
+        R[d, :cnt] = rows[sel] - d * blk
+        C[d, :cnt] = cols[sel]
+        V[d, :cnt] = vals[sel]
+    sh = grid.sharding(_ROWSPEC)
+    return DistSparseMatrix(
+        jax.device_put(jnp.asarray(V), sh),
+        jax.device_put(jnp.asarray(R), sh),
+        jax.device_put(jnp.asarray(C), sh),
+        (m, n), nnz, grid)

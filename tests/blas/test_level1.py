@@ -119,6 +119,8 @@ class TestTrapezoid:
         np.testing.assert_allclose(_g(out), X * 1e-290, rtol=1e-12)
         out = l1.safe_scale(3.0, 2.0, Xd)
         np.testing.assert_allclose(_g(out), X * 1.5)
+        with pytest.raises(ValueError, match="nonzero"):
+            l1.safe_scale(1.0, 0.0, Xd)
 
 
 class TestDiagonal:

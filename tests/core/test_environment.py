@@ -93,6 +93,13 @@ class TestArgs:
         with pytest.raises(ValueError, match="missing required"):
             a.process()
 
+    def test_dashed_value_consistency(self):
+        """A non-bool flag consumes the next token as its value even when it
+        starts with '--'; process() must tokenize identically."""
+        a = el.Args(["--name", "--weird"])
+        assert a.input("--name", "label", "d") == "--weird"
+        a.process()   # must not reject '--weird' as an unknown flag
+
     def test_report(self):
         a = el.Args(["--m", "3"])
         a.input("--m", "height", 100)
