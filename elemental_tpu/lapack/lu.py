@@ -113,7 +113,7 @@ def _panel_lu_unb(P, nbw: int):
     return lax.fori_loop(0, nbw, body, (P, jnp.arange(M)))
 
 
-def _panel_lu(P, nbw: int, precision=None, inner: int = 64):
+def _panel_lu(P, nbw: int, precision=None, inner: int = 128):
     """Two-level panel: unblocked ``inner``-wide chunks + matmul-shaped
     sub-updates.  The unblocked loop's per-column rank-1 update streams the
     whole panel each iteration (bandwidth-bound at nbw sequential passes);
