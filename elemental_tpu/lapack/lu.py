@@ -30,6 +30,12 @@ from ..core.view import view, update_view
 from ..redist.engine import redistribute
 from ..blas.level3 import _blocksize, _check_mcmr, trsm
 
+#: row-swap strategy for the p == 1 path: "full" (gather whole trailing
+#: block, contiguous writeback) or "moved" (scatter only displaced rows).
+_SWAP_MODE = "full"
+#: chunk-width ladder for the replicated panel factorization.
+_INNERS = (256, 32)
+
 
 # ---------------------------------------------------------------------
 # permutation utilities (the DistPermutation analog)
@@ -79,6 +85,22 @@ def _apply_swaps_storage(A: DistMatrix, T, pstep) -> DistMatrix:
     return A.with_local(stor.at[sidx].set(rows))
 
 
+def _apply_swaps_moved(A: DistMatrix, T, S, valid) -> DistMatrix:
+    """Move global rows ``S`` to positions ``T`` on the storage array,
+    dropping entries where ``valid`` is False (sentinel padding from
+    :func:`_moved_rows`).  The storage row map is a bijection between
+    slots and virtual indices, so invalid slots are forced out of range
+    rather than trusting the sentinel's arithmetic image."""
+    r, lr = A.col_stride, A.local_rows
+    m = A.gshape[0]
+    sidx = _storage_row(jnp.clip(T, 0, m - 1), r, lr)
+    sidx = jnp.where(valid, sidx, r * lr)          # OOB => scatter drops
+    gsrc = _storage_row(jnp.clip(S, 0, m - 1), r, lr)
+    stor = A.local
+    rows = jnp.take(stor, gsrc, axis=0)
+    return A.with_local(stor.at[sidx].set(rows, mode="drop"))
+
+
 # ---------------------------------------------------------------------
 # replicated panel factorization
 # ---------------------------------------------------------------------
@@ -113,22 +135,27 @@ def _panel_lu_unb(P, nbw: int):
     return lax.fori_loop(0, nbw, body, (P, jnp.arange(M)))
 
 
-def _panel_lu(P, nbw: int, precision=None, inner: int = 128):
-    """Two-level panel: unblocked ``inner``-wide chunks + matmul-shaped
+def _panel_lu(P, nbw: int, precision=None, inners=None):
+    """Multi-level blocked panel: ``inners``-wide chunk recursion + matmul
     sub-updates.  The unblocked loop's per-column rank-1 update streams the
-    whole panel each iteration (bandwidth-bound at nbw sequential passes);
-    restricting it to an ``inner``-wide chunk cuts that traffic ~nbw/inner
-    times while the chunk-to-chunk update becomes one MXU matmul.
+    whole chunk each iteration (bandwidth-bound at nbw sequential passes);
+    narrowing the innermost chunk to 32 columns cuts that traffic ~nbw/32
+    times while every chunk-to-chunk update is an MXU matmul.
 
     Returns (packed panel, composed row permutation of the panel)."""
-    if nbw <= inner:
+    if inners is None:
+        inners = _INNERS
+    if not inners or nbw <= inners[-1]:
         return _panel_lu_unb(P, nbw)
+    step, rest = inners[0], inners[1:]
+    if nbw <= step:
+        return _panel_lu(P, nbw, precision, rest)
     M = P.shape[0]
     perm = jnp.arange(M)
-    for s in range(0, nbw, inner):
-        e = min(s + inner, nbw)
+    for s in range(0, nbw, step):
+        e = min(s + step, nbw)
         w = e - s
-        sub, sperm = _panel_lu_unb(P[s:, s:e], w)      # perm rel. to row s
+        sub, sperm = _panel_lu(P[s:, s:e], w, precision, rest)
         rows = jnp.take(P[s:], sperm, axis=0)          # apply swaps to block-row
         rows = rows.at[:, s:e].set(sub)
         if e < nbw:
@@ -142,6 +169,44 @@ def _panel_lu(P, nbw: int, precision=None, inner: int = 128):
         P = P.at[s:].set(rows)
         perm = perm.at[s:].set(jnp.take(perm[s:], sperm, axis=0))
     return P, perm
+
+
+def _unit_lower_inv(L11, nbw: int, precision=None, bs: int = 256):
+    """Inverse of a unit-lower (nbw, nbw) panel block with matmul assembly
+    (small triangular_solve only at ``bs`` diagonal blocks) -- turns the
+    U12 := L11^{-1} A12 panel solve into one MXU matmul."""
+    dt = L11.dtype
+    if nbw <= bs:
+        return lax.linalg.triangular_solve(
+            L11, jnp.eye(nbw, dtype=dt), left_side=True, lower=True,
+            unit_diagonal=True)
+    Li = jnp.zeros((nbw, nbw), dt)
+    for s in range(0, nbw, bs):
+        e = min(s + bs, nbw)
+        Likk = lax.linalg.triangular_solve(
+            L11[s:e, s:e], jnp.eye(e - s, dtype=dt), left_side=True,
+            lower=True, unit_diagonal=True)
+        if s > 0:
+            corr = jnp.matmul(
+                Likk, jnp.matmul(L11[s:e, :s], Li[:s, :s],
+                                 precision=precision), precision=precision)
+            Li = Li.at[s:e, :s].set(-corr.astype(dt))
+        Li = Li.at[s:e, s:e].set(Likk)
+    return Li
+
+
+def _moved_rows(pperm, nbw: int):
+    """Indices (into the trailing block) actually displaced by the composed
+    panel permutation, padded to the static size 2*nbw with an out-of-range
+    sentinel.  A composition of nbw swaps touches at most 2*nbw positions,
+    so gather/scatter of just these rows replaces a full trailing-matrix
+    row permutation (the dominant swap cost at large n)."""
+    M = pperm.shape[0]
+    k = min(2 * nbw, M)
+    moved = pperm != jnp.arange(M)
+    idx = jnp.nonzero(moved, size=k, fill_value=M)[0]
+    src = pperm[jnp.clip(idx, 0, M - 1)]
+    return idx, src
 
 
 # ---------------------------------------------------------------------
@@ -163,12 +228,22 @@ def _local_lu(A: DistMatrix, nb: int | None, precision):
         nbw = e - s
         Pf, pperm = _panel_lu(a[s:, s:e], nbw, precision)
         perm = perm.at[s:].set(jnp.take(perm[s:], pperm, axis=0))
-        a = a.at[s:].set(jnp.take(a[s:], pperm, axis=0))
+        if _SWAP_MODE == "moved":
+            # swap only the rows the panel permutation displaced (<= 2*nbw)
+            idx, src = _moved_rows(pperm, nbw)
+            rows = jnp.take(a[s:], jnp.clip(src, 0, m - s - 1), axis=0)
+            a = a.at[jnp.asarray(s) + idx].set(rows, mode="drop")
+        else:
+            # full trailing-block gather + contiguous writeback (TPU scatters
+            # of dynamic row sets benchmark SLOWER than this full gather)
+            a = a.at[s:].set(jnp.take(a[s:], pperm, axis=0))
         a = a.at[s:, s:e].set(Pf)
         if e < n:
-            L11 = jnp.tril(Pf[:nbw], -1) + jnp.eye(nbw, dtype=a.dtype)
-            U1n = lax.linalg.triangular_solve(
-                L11, a[s:e, e:], left_side=True, lower=True, unit_diagonal=True)
+            Li11 = _unit_lower_inv(jnp.tril(Pf[:nbw], -1)
+                                   + jnp.eye(nbw, dtype=a.dtype),
+                                   nbw, precision)
+            U1n = jnp.matmul(Li11, a[s:e, e:], precision=precision
+                             ).astype(a.dtype)
             a = a.at[s:e, e:].set(U1n)
             if e < m:
                 upd = jnp.matmul(Pf[nbw:], U1n, precision=precision)
@@ -201,11 +276,13 @@ def lu(A: DistMatrix, nb: int | None = None, precision=None):
         e_up = min(-(-e // c) * c, n)
         panel = redistribute(view(A, rows=(s, m), cols=(s, e_up)), STAR, STAR)
         Pf, pperm = _panel_lu(panel.local[:, :nbw], nbw, precision)
-        pstep = jnp.concatenate([jnp.arange(s), pperm + s])  # full-m step perm
-        perm = perm[pstep]
-        # permute the trailing rows across ALL columns (the panel region is
-        # overwritten by the factored panel right after)
-        A = _apply_swaps_storage(A, jnp.arange(s, m), pstep)
+        perm = perm.at[s:].set(jnp.take(perm[s:], pperm, axis=0))
+        # move only the rows the panel permutation displaced (<= 2*nbw)
+        # across ALL columns (the panel region is overwritten right after)
+        idx, src = _moved_rows(pperm, nbw)
+        valid = idx < (m - s)
+        A = _apply_swaps_moved(A, idx + s, jnp.clip(src, 0, m - s - 1) + s,
+                               valid)
         # write back the factored panel (rows s..m of cols s..e)
         if e_up > e:
             Pf_w = jnp.pad(Pf, ((0, 0), (0, e_up - e)))
@@ -216,10 +293,12 @@ def lu(A: DistMatrix, nb: int | None = None, precision=None):
         # U12 := L11^{-1} A12 ; A22 -= L21 U12.  The solve runs over the full
         # legal column range (s, n) and the writeback keeps only cols >= e.
         if e < n:
-            L11 = jnp.tril(Pf[:nbw, :], -1) + jnp.eye(nbw, dtype=Pf.dtype)
+            Li11 = _unit_lower_inv(jnp.tril(Pf[:nbw, :], -1)
+                                   + jnp.eye(nbw, dtype=Pf.dtype),
+                                   nbw, precision)
             A1n = redistribute(view(A, rows=(s, e), cols=(s, n)), STAR, VR)
-            u1n = lax.linalg.triangular_solve(
-                L11, A1n.local, left_side=True, lower=True, unit_diagonal=True)
+            u1n = jnp.matmul(Li11, A1n.local, precision=precision
+                             ).astype(Pf.dtype)
             U1n = DistMatrix(u1n, (nbw, n - s), STAR, VR, 0, 0, g)
             U1n_mr = redistribute(U1n, STAR, MR)
             A = _update_cols_ge(A, redistribute(U1n_mr, MC, MR), (s, e), (s, n), e)
