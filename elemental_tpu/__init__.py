@@ -44,10 +44,13 @@ from .lapack import (hermitian_tridiag, apply_q_herm_tridiag, hessenberg,
 from .lapack import ldl, ldl_solve_after, symmetric_solve, hermitian_solve, inertia
 from .lapack import (polar, sign, inverse, triangular_inverse, hpd_inverse,
                      pseudoinverse, square_root, hpd_square_root)
-from .lapack import herm_eig, skew_herm_eig, herm_gen_def_eig, hermitian_svd, svd
+from .lapack import (herm_eig, skew_herm_eig, herm_gen_def_eig, hermitian_svd,
+                     svd, tridiag_eig)
 from .redist.interior import interior_view, interior_update, vstack, hstack
 from .optimization import (MehrotraCtrl, lp, qp, socp, soft_threshold, svt,
-                           bp, lav, nnls, lasso, svm, rpca)
+                           bp, lav, nnls, lasso, svm, rpca,
+                           lp_affine, qp_affine, socp_affine,
+                           ruiz_equil, geom_equil, symmetric_ruiz_equil)
 from .control import sylvester, lyapunov, riccati
 from .lapack.schur import schur, triang_eig, eig, pseudospectra
 from .lapack.props import (determinant, safe_determinant, hpd_determinant,

@@ -13,6 +13,7 @@ from .funcs import (polar, sign, inverse, triangular_inverse, hpd_inverse,
                     pseudoinverse, square_root, hpd_square_root)
 from .spectral import (herm_eig, skew_herm_eig, herm_gen_def_eig,
                        hermitian_svd, svd)
+from .tridiag_eig import tridiag_eig
 from .schur import schur, triang_eig, eig, pseudospectra
 from .props import (determinant, safe_determinant, hpd_determinant,
                     two_norm_estimate, condition, inertia as matrix_inertia,

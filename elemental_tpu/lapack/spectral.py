@@ -32,8 +32,18 @@ from ..redist.interior import interior_view
 from ..blas.level1 import diagonal_scale, make_trapezoidal
 from .cholesky import cholesky
 from .condense import hermitian_tridiag, apply_q_herm_tridiag, _real_dtype
-from .lu import permute_cols
+from .lu import permute_cols, _hi
 from .qr import qr, apply_q
+from .tridiag_eig import tridiag_eig
+
+# Above this order the tridiagonal EVP switches from the replicated
+# jnp.linalg.eigh fallback to the scalable Cuppen D&C (:mod:`.tridiag_eig`,
+# the PMRRR analog) -- no replicated n x n array is materialized past its
+# ``repl_max``.  The switchover is tied to repl_max: below it the D&C would
+# still run fully replicated (no memory win) at slightly lower accuracy
+# than the direct eigh, so there is nothing to gain.
+_DC_MIN = 512
+_REPL_MAX = 512
 
 
 def _sym_from_triangle(Ag, uplo: str):
@@ -73,7 +83,8 @@ def _subset_slice(w, subset):
 
 def herm_eig(A: DistMatrix, uplo: str = "L", vectors: bool = True,
              subset=None, nb: int | None = None, approach: str = "tridiag",
-             precision=None):
+             precision=None, dc_min: int | None = None,
+             repl_max: int | None = None):
     """Eigendecomposition of a Hermitian [MC,MR] matrix: ``A = Z diag(w) Z^H``
     (``El::HermitianEig``).  Returns ascending real ``w`` (replicated) and,
     when ``vectors``, the distributed eigenvector matrix ``Z``.
@@ -97,7 +108,30 @@ def herm_eig(A: DistMatrix, uplo: str = "L", vectors: bool = True,
     if approach == "qdwh":
         from .funcs import _qdwh_eig
         return _qdwh_eig(A, uplo, vectors, subset, nb, precision)
-    Ap, d, e_, tau = hermitian_tridiag(A, uplo, nb=nb, precision=precision)
+    Ap, d, e_, tau = hermitian_tridiag(A, uplo, nb=nb, precision=_hi(precision))
+    if dc_min is None:
+        dc_min = _DC_MIN
+    if repl_max is None:
+        repl_max = _REPL_MAX
+    if n > dc_min:
+        # scalable Cuppen D&C tridiagonal stage (the PMRRR replacement):
+        # above repl_max the eigenvector matrix only ever exists [MC,MR]
+        if not vectors:
+            w = tridiag_eig(d, e_, grid=None, vectors=False,
+                            repl_max=repl_max, precision=_hi(precision))
+            s, e = _subset_slice(w, subset)
+            return w[s:e].astype(rdtype)
+        w, ZTd = tridiag_eig(d, e_, grid=g, vectors=True, repl_max=repl_max,
+                             precision=_hi(precision))
+        s, e = _subset_slice(w, subset)
+        w = w[s:e].astype(rdtype)
+        if (s, e) != (0, n):
+            ZTd = interior_view(ZTd, (0, n), (s, e))
+        if ZTd.dtype != A.dtype:
+            ZTd = ZTd.astype(A.dtype)
+        Z = apply_q_herm_tridiag(Ap, tau, ZTd, orient="N", nb=nb,
+                                 precision=_hi(precision))
+        return w, Z
     T = (jnp.diag(d) + jnp.diag(e_, -1) + jnp.diag(e_, 1)).astype(rdtype)
     w, ZT = jnp.linalg.eigh(T)            # redundant replicated tridiag solve
     s, e = _subset_slice(w, subset)
@@ -109,7 +143,7 @@ def herm_eig(A: DistMatrix, uplo: str = "L", vectors: bool = True,
         DistMatrix(ZT[:, s:e].astype(A.dtype), (n, k), STAR, STAR, 0, 0, g),
         MC, MR)
     Z = apply_q_herm_tridiag(Ap, tau, ZTd, orient="N", nb=nb,
-                             precision=precision)
+                             precision=_hi(precision))
     return w, Z
 
 
@@ -139,7 +173,7 @@ def skew_herm_eig(A: DistMatrix, uplo: str = "L", vectors: bool = True,
     iA = A.with_local((1j * A.local.astype(cdtype)))
     n = A.gshape[0]
     out = herm_eig(iA, uplo, vectors, _translate_skew_subset(subset, n), nb,
-                   approach=approach, precision=precision)
+                   approach=approach, precision=_hi(precision))
     # eig(A) = -i * eig(iA): imaginary parts are -w; re-sort ascending.
     if not vectors:
         return -out[::-1]
@@ -156,14 +190,14 @@ def herm_gen_def_eig(A: DistMatrix, B: DistMatrix, uplo: str = "L",
     (``El::HermitianGenDefEig``, AXBX form): Cholesky B = L L^H, reduce via
     ``TwoSidedTrsm`` to ``L^-1 A L^-H``, solve, back-substitute
     ``x = L^-H y``."""
-    L = cholesky(B, "L", nb=nb, precision=precision)
-    C = two_sided_trsm(uplo, A, L, nb=nb, precision=precision)
+    L = cholesky(B, "L", nb=nb, precision=_hi(precision))
+    C = two_sided_trsm(uplo, A, L, nb=nb, precision=_hi(precision))
     out = herm_eig(C, uplo, vectors, subset, nb=nb, approach=approach,
-                   precision=precision)
+                   precision=_hi(precision))
     if not vectors:
         return out
     w, Y = out
-    X = trsm("L", "L", "C", L, Y, nb=nb, precision=precision)
+    X = trsm("L", "L", "C", L, Y, nb=nb, precision=_hi(precision))
     return w, X
 
 
@@ -177,7 +211,7 @@ def hermitian_svd(A: DistMatrix, uplo: str = "L", vectors: bool = True,
     """SVD of a Hermitian matrix via its eigendecomposition
     (``El::HermitianSVD``): s = |w| descending, U = Z*sign(w), V = Z."""
     out = herm_eig(A, uplo, vectors, nb=nb, approach=approach,
-                   precision=precision)
+                   precision=_hi(precision))
     if not vectors:
         w = out
         return jnp.sort(jnp.abs(w))[::-1]
@@ -223,7 +257,7 @@ def svd(A: DistMatrix, vectors: bool = True, approach: str = "auto",
         approach = "chan" if m >= max(int(1.5 * n), n + 1) else "polar"
 
     if approach == "chan" and m > n:
-        Ap, tau = qr(A, nb=nb, precision=precision)
+        Ap, tau = qr(A, nb=nb, precision=_hi(precision))
         Rd = make_trapezoidal(interior_view(Ap, (0, n), (0, n)), "U")
         out = svd(Rd, vectors, "polar" if n > 128 else "local", nb, precision,
                   eig_approach)
@@ -232,7 +266,7 @@ def svd(A: DistMatrix, vectors: bool = True, approach: str = "auto",
         UR, s, V = out
         # U = Q [UR; 0] -- the row pad is a pure-local storage extension
         U0 = pad_matrix(UR, m, n)
-        U = apply_q(Ap, tau, U0, orient="N", nb=nb, precision=precision)
+        U = apply_q(Ap, tau, U0, orient="N", nb=nb, precision=_hi(precision))
         return U, s, V
 
     if approach == "golub":
@@ -276,7 +310,7 @@ def _svd_golub_kahan(A: DistMatrix, vectors: bool, nb, precision,
     m, n = A.gshape
     g = A.grid
     rdtype = _real_dtype(A.dtype)
-    Ap, d, e, tauq, taup = bidiag(A, nb=nb, precision=precision)
+    Ap, d, e, tauq, taup = bidiag(A, nb=nb, precision=_hi(precision))
     epad = jnp.concatenate([jnp.zeros((1,), rdtype), e])      # e_{j-1} at j
     enext = jnp.concatenate([e, jnp.zeros((1,), rdtype)])     # e_j at j
     T0 = dm_zeros(n, n, MC, MR, g, dtype=rdtype)
@@ -294,7 +328,7 @@ def _svd_golub_kahan(A: DistMatrix, vectors: bool, nb, precision,
 
     T = index_dependent_fill(T0, tfill)
     out = herm_eig(T, "L", vectors, nb=nb, approach=eig_approach,
-                   precision=precision)
+                   precision=_hi(precision))
     if not vectors:
         w = out
         return jnp.sqrt(jnp.clip(jnp.sort(w)[::-1], 0, None))
@@ -313,9 +347,9 @@ def _svd_golub_kahan(A: DistMatrix, vectors: bool, nb, precision,
     sinv = jnp.where(s > 0, 1.0 / jnp.where(s == 0, 1.0, s), 0)
     ds = DistMatrix(sinv[:, None].astype(A.dtype), (n, 1), STAR, STAR, 0, 0, g)
     UB = diagonal_scale("R", ds, BV)
-    V = apply_p_bidiag(Ap, taup, VB, orient="N", nb=nb, precision=precision)
+    V = apply_p_bidiag(Ap, taup, VB, orient="N", nb=nb, precision=_hi(precision))
     U = apply_q(Ap, tauq, pad_matrix(UB, m, n), orient="N", nb=nb,
-                precision=precision)
+                precision=_hi(precision))
     return U, s, V
 
 
@@ -323,16 +357,16 @@ def _svd_polar(A: DistMatrix, vectors: bool, nb, precision,
                eig_approach: str):
     # polar path: A = Up H; H = V diag(w) V^H; s = w desc; U = Up V
     from .funcs import polar
-    Up, H = polar(A, nb=nb, precision=precision)
+    Up, H = polar(A, nb=nb, precision=_hi(precision))
     if not vectors:
         w = herm_eig(H, "L", vectors=False, nb=nb, approach=eig_approach,
-                     precision=precision)
+                     precision=_hi(precision))
         return jnp.clip(jnp.sort(w)[::-1], 0, None)
     w, V = herm_eig(H, "L", True, nb=nb, approach=eig_approach,
-                    precision=precision)
+                    precision=_hi(precision))
     # H is PSD: w ascending >= 0 (up to rounding); descending order
     order = jnp.argsort(-w)
     s = jnp.clip(w[order], 0, None)
     Vd = permute_cols(V, order)
-    U = gemm(Up, Vd, precision=precision)
+    U = gemm(Up, Vd, precision=_hi(precision))
     return U, s, Vd

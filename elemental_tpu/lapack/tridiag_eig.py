@@ -44,6 +44,7 @@ float32 otherwise (TPU), independent of the storage dtype.
 from __future__ import annotations
 
 import math
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -55,6 +56,7 @@ from ..redist.engine import redistribute
 from ..redist.interior import interior_view, interior_update
 from ..blas.level1 import index_dependent_fill
 from ..blas.level3 import gemm
+from .lu import _hi
 
 
 def _sec_dtype():
@@ -244,13 +246,16 @@ def _merge_replicated(lam1, lam2, Q1, Q2, beta, scale, n_iters, chunk,
     n2 = 2 * nm
     D = jnp.concatenate([lam1, lam2])
     z = jnp.concatenate([Q1[-1, :], Q2[0, :]])
-    lam, perm, ds, mu, zhat, cninv, flip = _secular(
+    lam, perm, ds, tau, aidx, zhat, cninv, flip = _secular(
         D, z, beta, scale, n_iters, chunk)
     rows = jnp.arange(n2)[:, None]
     cols = jnp.arange(n2)[None, :]
-    V = _v_entries(rows, cols, perm, ds, mu, zhat, cninv, flip, Q1.dtype)
-    top = jnp.matmul(Q1, V[:nm, :], precision=precision)
-    bot = jnp.matmul(Q2, V[nm:, :], precision=precision)
+    V = _v_entries(rows, cols, perm, ds, tau, aidx, zhat, cninv, flip,
+                   Q1.dtype)
+    # eigenvector accumulation is factor-forming: full f32 accumulation
+    # (default bf16-input matmul costs ~1e-3 residuals on TPU)
+    top = jnp.matmul(Q1, V[:nm, :], precision=_hi(precision))
+    bot = jnp.matmul(Q2, V[nm:, :], precision=_hi(precision))
     return lam.astype(lam1.dtype), jnp.concatenate([top, bot], axis=0)
 
 
@@ -263,11 +268,12 @@ def _merge_rows_only(lam1, lam2, fr1, lr1, fr2, lr2, beta, scale, n_iters,
     n2 = 2 * nm
     D = jnp.concatenate([lam1, lam2])
     z = jnp.concatenate([lr1, fr2])
-    lam, perm, ds, mu, zhat, cninv, flip = _secular(
+    lam, perm, ds, tau, aidx, zhat, cninv, flip = _secular(
         D, z, beta, scale, n_iters, chunk)
     rows = jnp.arange(n2)[:, None]
     cols = jnp.arange(n2)[None, :]
-    V = _v_entries(rows, cols, perm, ds, mu, zhat, cninv, flip, fr1.dtype)
+    V = _v_entries(rows, cols, perm, ds, tau, aidx, zhat, cninv, flip,
+                   fr1.dtype)
     fr = jnp.concatenate([fr1, jnp.zeros_like(fr2)]) @ V
     lr = jnp.concatenate([jnp.zeros_like(lr1), lr2]) @ V
     return lam.astype(lam1.dtype), fr, lr
@@ -311,7 +317,21 @@ def tridiag_eig(d, e, grid=None, vectors: bool = True,
     The scalable replacement for the reference's PMRRR tridiagonal kernel
     (``src/core/imports/pmrrr.cpp``): above ``repl_max`` no replicated
     n x n array is ever materialized.
+
+    The whole driver runs under ONE jit (static plan metadata): eager
+    per-op dispatch of its hundreds of small secular-stage ops is fine on
+    CPU but pathological on remote/tunneled TPU backends.
     """
+    d = jnp.asarray(d)
+    e = jnp.asarray(e)
+    return _tridiag_eig_jit(d, e, grid, vectors, leaf_max, repl_max,
+                            chunk, precision)
+
+
+@partial(jax.jit, static_argnames=("grid", "vectors", "leaf_max",
+                                   "repl_max", "chunk", "precision"))
+def _tridiag_eig_jit(d, e, grid, vectors, leaf_max, repl_max, chunk,
+                     precision):
     sdt = _sec_dtype()
     n = d.shape[0]
     odt = jnp.result_type(jnp.asarray(d).dtype, jnp.float32)
@@ -413,19 +433,20 @@ def tridiag_eig(d, e, grid=None, vectors: bool = True,
                               STAR, STAR).local[0]
             D = jnp.concatenate([lam1, lam2])
             z = jnp.concatenate([z1, z2]).astype(sdt)
-            lamn, perm, ds, mu, zhat, cninv, flip = _secular(
+            lamn, perm, ds, tau, aidx, zhat, cninv, flip = _secular(
                 D, z, beta, scale, n_iters, chunk)
 
-            def vfill(i, j, _p=perm, _ds=ds, _mu=mu, _zh=zhat,
+            def vfill(i, j, _p=perm, _ds=ds, _tau=tau, _ai=aidx, _zh=zhat,
                       _cn=cninv, _fl=flip):
-                return _v_entries(i, j, _p, _ds, _mu, _zh, _cn, _fl, odt)
+                return _v_entries(i, j, _p, _ds, _tau, _ai, _zh, _cn, _fl,
+                                  odt)
 
             V = index_dependent_fill(
                 dm_zeros(2 * nm, 2 * nm, MC, MR, grid, dtype=odt), vfill)
             Vtop = interior_view(V, (0, nm), (0, 2 * nm))
             Vbot = interior_view(V, (nm, 2 * nm), (0, 2 * nm))
-            Ztop = gemm(Q1, Vtop, precision=precision)
-            Zbot = gemm(Q2, Vbot, precision=precision)
+            Ztop = gemm(Q1, Vtop, precision=_hi(precision))
+            Zbot = gemm(Q2, Vbot, precision=_hi(precision))
             Qd = interior_update(Qd, Ztop, (o, o))
             Qd = interior_update(Qd, Zbot, (o + nm, o))
             lam_full = lax.dynamic_update_slice(lam_full, lamn, (o,))
