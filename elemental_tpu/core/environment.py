@@ -23,6 +23,13 @@ from dataclasses import dataclass, field
 
 # ---------------------------------------------------------------------------
 # Blocksize stack (El::Blocksize / SetBlocksize / Push/PopBlocksizeStack)
+#
+# jit caveat: the blocksize is read at TRACE time (it shapes the blocked
+# loops), so it is baked into every compiled executable.  Changing it and
+# re-calling a jitted driver triggers a fresh XLA compile (and jit caching
+# keyed only on shapes/dtypes will NOT notice a blocksize change inside an
+# already-traced closure -- pass nb explicitly to jitted entry points, or
+# jit after setting the blocksize).
 # ---------------------------------------------------------------------------
 
 _DEFAULT_BLOCKSIZE = 128

@@ -30,6 +30,7 @@ from ..core.view import view, update_view
 from ..redist.engine import redistribute, transpose_dist
 from ..blas.level1 import make_trapezoidal
 from ..blas.level3 import _blocksize, _check_mcmr, _mask_triangle, trsm
+from .lu import _hi
 
 
 def _potrf_inv(D, precision, bs: int = 512):
@@ -46,6 +47,8 @@ def _potrf_inv(D, precision, bs: int = 512):
     numerically benign at panel sizes since cond(L11) ~ sqrt(cond(A11))."""
     w = D.shape[0]
     dt = D.dtype
+    # factor-forming matmuls run at full accumulation (see lu._hi)
+    precision = _hi(precision)
     d = jnp.tril(D)
     d = d + jnp.conj(jnp.tril(d, -1)).T
     if w <= bs:
@@ -111,7 +114,7 @@ def _local_cholesky(A: DistMatrix, nb: int | None, precision) -> DistMatrix:
             panels.append(L11)
             break
         L21 = jnp.matmul(T[w:, :w], jnp.conj(Li11).T,
-                         precision=precision).astype(a.dtype)
+                         precision=_hi(precision)).astype(a.dtype)
         panels.append(jnp.concatenate([L11, L21], axis=0))
         T2 = T[w:, w:]
         mt = T2.shape[0]
@@ -162,7 +165,7 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | None = None,
             break
         A21_vc = redistribute(view(L, rows=(e, m), cols=(s, e)), VC, STAR)
         x21 = jnp.matmul(A21_vc.local, jnp.conj(Li11).T,
-                         precision=precision).astype(L.dtype)  # A21 L11^{-H}
+                         precision=_hi(precision)).astype(L.dtype)  # A21 L11^{-H}
         L21_vc = DistMatrix(x21, (m - e, e - s), VC, STAR, 0, 0, g)
         L21_mc = redistribute(L21_vc, MC, STAR)
         L21H_mr = redistribute(transpose_dist(L21_vc, conj=True), STAR, MR)

@@ -38,7 +38,7 @@ from ..redist.engine import redistribute, transpose_dist
 from ..blas.level2 import gemv, hemv
 from ..blas.level1 import _global_indices
 from ..blas.level3 import _blocksize, _check_mcmr, _mask_triangle
-from .lu import _update_cols_lt
+from .lu import _update_cols_lt, _hi
 from .qr import _larft
 
 
@@ -108,7 +108,7 @@ def _tridiag_panel(Atrail: DistMatrix, P, nbw: int, extract_last: bool,
         e = e.at[jj].set(beta.astype(rdtype))
         # the one distributed op per column: u = A_trail v (Hemv; v's leading
         # zeros make this the reference's A22*v on the true subproblem)
-        u = _unwrap_vec(hemv("L", Atrail, _wrap_vec(v, g), precision=precision))
+        u = _unwrap_vec(hemv("L", Atrail, _wrap_vec(v, g), precision=_hi(precision)))
         u = u - V @ (jnp.conj(W).T @ v) - W @ (jnp.conj(V).T @ v)
         w = tau_j * u
         w = jnp.where(ridx > jj, w, 0)
@@ -206,8 +206,8 @@ def hermitian_tridiag(A: DistMatrix, uplo: str = "L", nb: int | None = None,
         W2Hmr = redistribute(
             DistMatrix(jnp.conj(W2).T, (nbw, nt2), STAR, STAR, 0, 0, g), STAR, MR)
         A22 = view(Ap, rows=(e_col, n), cols=(e_col, n))
-        upd = (jnp.matmul(V2mc.local, W2Hmr.local, precision=precision)
-               + jnp.matmul(W2mc.local, V2Hmr.local, precision=precision))
+        upd = (jnp.matmul(V2mc.local, W2Hmr.local, precision=_hi(precision))
+               + jnp.matmul(W2mc.local, V2Hmr.local, precision=_hi(precision)))
         mask = _mask_triangle(A22, "L")
         newloc = jnp.where(mask, A22.local - upd.astype(dtype), A22.local)
         Ap = update_view(Ap, A22.with_local(newloc), rows=(e_col, n), cols=(e_col, n))
@@ -256,9 +256,9 @@ def apply_q_herm_tridiag(Ap: DistMatrix, tau, B: DistMatrix,
         V_mc = redistribute(
             DistMatrix(V, (n - s, nbw), STAR, STAR, 0, 0, g), MC, STAR)
         B2 = view(B, rows=(s, n))
-        Wl = jnp.matmul(jnp.conj(V_mc.local).T, B2.local, precision=precision)
-        Wl = jnp.matmul(Tm, Wl, precision=precision)
-        upd = jnp.matmul(V_mc.local, Wl, precision=precision)
+        Wl = jnp.matmul(jnp.conj(V_mc.local).T, B2.local, precision=_hi(precision))
+        Wl = jnp.matmul(Tm, Wl, precision=_hi(precision))
+        upd = jnp.matmul(V_mc.local, Wl, precision=_hi(precision))
         B = update_view(B, B2.with_local(B2.local - upd.astype(B.dtype)),
                         rows=(s, n))
     return B
@@ -293,7 +293,7 @@ def _bidiag_panel(Atrail: DistMatrix, Pc, Pr, nbw: int, precision):
         # zlarfg: H^H x = beta e, so the left update A <- H^H A is
         # A - u y^H with y = tq * A_cur^H u
         base = _unwrap_vec(gemv(Atrail, _wrap_vec(u, g), orient="C",
-                                precision=precision))
+                                precision=_hi(precision)))
         y = base - Y @ (jnp.conj(U).T @ u) - V @ (jnp.conj(X).T @ u)
         y = (tq * y).astype(dtype)
         U = U.at[:, j].set(u)
@@ -309,7 +309,7 @@ def _bidiag_panel(Atrail: DistMatrix, Pc, Pr, nbw: int, precision):
         e = e.at[j].set(jnp.where(do_right, betar, 0).astype(rdtype))
         # right update A <- A G with G = I - tp v v^H: x = tp * A_cur v
         basex = _unwrap_vec(gemv(Atrail, _wrap_vec(v, g), orient="N",
-                                 precision=precision))
+                                 precision=_hi(precision)))
         x = basex - U @ (jnp.conj(Y).T @ v) - X @ (jnp.conj(V).T @ v)
         x = (tp * x).astype(dtype)
         V = V.at[:, j].set(v)
@@ -420,8 +420,8 @@ def bidiag(A: DistMatrix, nb: int | None = None, precision=None):
         V2Hmr = redistribute(DistMatrix(jnp.conj(V2).T, (nbw, nt2), STAR,
                                         STAR, 0, 0, g), STAR, MR)
         A22 = view(Ap, rows=(e_col, m), cols=(e_col, n))
-        upd = (jnp.matmul(U2mc.local, Y2Hmr.local, precision=precision)
-               + jnp.matmul(X2mc.local, V2Hmr.local, precision=precision))
+        upd = (jnp.matmul(U2mc.local, Y2Hmr.local, precision=_hi(precision))
+               + jnp.matmul(X2mc.local, V2Hmr.local, precision=_hi(precision)))
         Ap = update_view(Ap, A22.with_local(A22.local - upd.astype(dtype)),
                          rows=(e_col, m), cols=(e_col, n))
     d = jnp.concatenate(d_parts)[:n]
@@ -464,9 +464,9 @@ def apply_p_bidiag(Ap: DistMatrix, taup, B: DistMatrix, orient: str = "N",
         V_mc = redistribute(
             DistMatrix(V, (nt, nbw), STAR, STAR, 0, 0, g), MC, STAR)
         B2 = view(B, rows=(s, n))
-        Wl = jnp.matmul(jnp.conj(V_mc.local).T, B2.local, precision=precision)
-        Wl = jnp.matmul(Tm, Wl, precision=precision)
-        upd = jnp.matmul(V_mc.local, Wl, precision=precision)
+        Wl = jnp.matmul(jnp.conj(V_mc.local).T, B2.local, precision=_hi(precision))
+        Wl = jnp.matmul(Tm, Wl, precision=_hi(precision))
+        upd = jnp.matmul(V_mc.local, Wl, precision=_hi(precision))
         B = update_view(B, B2.with_local(B2.local - upd.astype(B.dtype)),
                         rows=(s, n))
     return B
@@ -541,7 +541,7 @@ def apply_q_hessenberg(Qp: DistMatrix, tau, B: DistMatrix, orient: str = "N",
     T = _larft(V, tau)
     Tm = jnp.conj(T).T if orient == "C" else T
     V_mc = redistribute(DistMatrix(V, (n, nref), STAR, STAR, 0, 0, g), MC, STAR)
-    Wl = jnp.matmul(jnp.conj(V_mc.local).T, B.local, precision=precision)
-    Wl = jnp.matmul(Tm, Wl, precision=precision)
-    upd = jnp.matmul(V_mc.local, Wl, precision=precision)
+    Wl = jnp.matmul(jnp.conj(V_mc.local).T, B.local, precision=_hi(precision))
+    Wl = jnp.matmul(Tm, Wl, precision=_hi(precision))
+    upd = jnp.matmul(V_mc.local, Wl, precision=_hi(precision))
     return B.with_local(B.local - upd.astype(B.dtype))

@@ -35,7 +35,7 @@ from ..blas.level1 import (frobenius_norm, one_norm, infinity_norm,
                            trace as dm_trace)
 from ..blas.level3 import _check_mcmr, gemm, trsm, herk
 from .cholesky import cholesky, hpd_solve
-from .lu import lu_solve
+from .lu import lu_solve, _hi
 from .qr import qr, apply_q
 
 
@@ -89,11 +89,11 @@ def _qdwh_step_chol(X: DistMatrix, a, b, c, nb, precision) -> DistMatrix:
     """Cholesky-variant step (safe once c is moderate): Z = I + c X^H X,
     Z = W W^H, X' = (b/c) X + (a - b/c) X W^{-H} W^{-1}."""
     n = X.gshape[1]
-    Z = herk("L", X, alpha=c, orient="C", nb=nb, precision=precision)
+    Z = herk("L", X, alpha=c, orient="C", nb=nb, precision=_hi(precision))
     Z = shift_diagonal(Z, 1)
-    W = cholesky(Z, "L", nb=nb, precision=precision)
-    B = trsm("R", "L", "C", W, X, nb=nb, precision=precision)   # X W^{-H}
-    B = trsm("R", "L", "N", W, B, nb=nb, precision=precision)   # ... W^{-1}
+    W = cholesky(Z, "L", nb=nb, precision=_hi(precision))
+    B = trsm("R", "L", "C", W, X, nb=nb, precision=_hi(precision))   # X W^{-H}
+    B = trsm("R", "L", "N", W, B, nb=nb, precision=_hi(precision))   # ... W^{-1}
     return X.with_local((b / c) * X.local + (a - b / c) * B.local)
 
 
@@ -103,13 +103,13 @@ def _qdwh_step_qr(X: DistMatrix, a, b, c, nb, precision) -> DistMatrix:
     m, n = X.gshape
     sc = math.sqrt(c)
     S = vstack(X.with_local(sc * X.local), _identity_like(X, n, n))
-    Ap, tau = qr(S, nb=nb, precision=precision)
+    Ap, tau = qr(S, nb=nb, precision=_hi(precision))
     # thin Q = Q [I; 0]
     E = _identity_like(X, m + n, n)
-    Qthin = apply_q(Ap, tau, E, orient="N", nb=nb, precision=precision)
+    Qthin = apply_q(Ap, tau, E, orient="N", nb=nb, precision=_hi(precision))
     Q1 = interior_view(Qthin, (0, m), (0, n))
     Q2 = interior_view(Qthin, (m, m + n), (0, n))
-    G = gemm(Q1, Q2, orient_b="C", nb=nb, precision=precision)
+    G = gemm(Q1, Q2, orient_b="C", nb=nb, precision=_hi(precision))
     return X.with_local((b / c) * X.local + ((a - b / c) / sc) * G.local)
 
 
@@ -125,10 +125,10 @@ def polar(A: DistMatrix, nb: int | None = None, precision=None,
     if m < n:
         # A^H = W K  =>  A = (W^H)(W K W^H)
         W, K = polar(redistribute(transpose_dist(A, conj=True), MC, MR),
-                     nb=nb, precision=precision, l_min=l_min)
+                     nb=nb, precision=_hi(precision), l_min=l_min)
         U = redistribute(transpose_dist(W, conj=True), MC, MR)
-        H = gemm(gemm(W, K, nb=nb, precision=precision), W, orient_b="C",
-                 nb=nb, precision=precision)
+        H = gemm(gemm(W, K, nb=nb, precision=_hi(precision)), W, orient_b="C",
+                 nb=nb, precision=_hi(precision))
         return U, _hermitianize(H)
 
     alpha = float(jnp.sqrt(jnp.maximum(one_norm(A) * infinity_norm(A),
@@ -144,7 +144,7 @@ def polar(A: DistMatrix, nb: int | None = None, precision=None,
         else:
             X = _qdwh_step_chol(X, a, b, c, nb, precision)
     U = X
-    H = gemm(U, A, orient_a="C", nb=nb, precision=precision)
+    H = gemm(U, A, orient_a="C", nb=nb, precision=_hi(precision))
     return U, _hermitianize(H)
 
 
@@ -170,7 +170,7 @@ def sign(A: DistMatrix, nb: int | None = None, precision=None,
     X = A
     I = _identity_like(A, n)
     for it in range(maxiter):
-        Xi = lu_solve(X, I, nb=nb, precision=precision)
+        Xi = lu_solve(X, I, nb=nb, precision=_hi(precision))
         nx = float(frobenius_norm(X))
         ni = float(frobenius_norm(Xi))
         if not np.isfinite(nx) or not np.isfinite(ni):
@@ -195,7 +195,7 @@ def inverse(A: DistMatrix, nb: int | None = None, precision=None) -> DistMatrix:
     n = A.gshape[0]
     if A.gshape != (n, n):
         raise ValueError(f"inverse needs square, got {A.gshape}")
-    return lu_solve(A, _identity_like(A, n), nb=nb, precision=precision)
+    return lu_solve(A, _identity_like(A, n), nb=nb, precision=_hi(precision))
 
 
 def triangular_inverse(uplo: str, A: DistMatrix, unit: bool = False,
@@ -204,7 +204,7 @@ def triangular_inverse(uplo: str, A: DistMatrix, unit: bool = False,
     _check_mcmr(A)
     n = A.gshape[0]
     return trsm("L", uplo, "N", A, _identity_like(A, n), unit=unit,
-                nb=nb, precision=precision)
+                nb=nb, precision=_hi(precision))
 
 
 def hpd_inverse(A: DistMatrix, uplo: str = "L", nb: int | None = None,
@@ -212,7 +212,7 @@ def hpd_inverse(A: DistMatrix, uplo: str = "L", nb: int | None = None,
     """Inverse of an HPD matrix via Cholesky (``El::HPDInverse``)."""
     _check_mcmr(A)
     n = A.gshape[0]
-    return hpd_solve(A, _identity_like(A, n), uplo, nb=nb, precision=precision)
+    return hpd_solve(A, _identity_like(A, n), uplo, nb=nb, precision=_hi(precision))
 
 
 def pseudoinverse(A: DistMatrix, tol: float | None = None,
@@ -222,14 +222,14 @@ def pseudoinverse(A: DistMatrix, tol: float | None = None,
     from ..blas.level1 import diagonal_scale
     from .spectral import svd
     m, n = A.gshape
-    U, s, V = svd(A, vectors=True, nb=nb, precision=precision)
+    U, s, V = svd(A, vectors=True, nb=nb, precision=_hi(precision))
     smax = float(s[0]) if s.shape[0] else 0.0
     cut = tol if tol is not None else max(m, n) * _eps_of(A.dtype) * smax
     sinv = jnp.where(s > cut, 1.0 / jnp.where(s > cut, s, 1.0), 0.0)
     d = DistMatrix(sinv[:, None].astype(A.dtype), (s.shape[0], 1),
                    STAR, STAR, 0, 0, A.grid)
     Vs = diagonal_scale("R", d, V)
-    return gemm(Vs, U, orient_b="C", nb=nb, precision=precision)
+    return gemm(Vs, U, orient_b="C", nb=nb, precision=_hi(precision))
 
 
 # ---------------------------------------------------------------------
@@ -252,8 +252,8 @@ def square_root(A: DistMatrix, nb: int | None = None, precision=None,
     I = _identity_like(A, n)
     Y, Z = A, I
     for _ in range(maxiter):
-        Yi = lu_solve(Y, I, nb=nb, precision=precision)
-        Zi = lu_solve(Z, I, nb=nb, precision=precision)
+        Yi = lu_solve(Y, I, nb=nb, precision=_hi(precision))
+        Zi = lu_solve(Z, I, nb=nb, precision=_hi(precision))
         Ynew = Y.with_local(0.5 * (Y.local + Zi.local))
         Z = Z.with_local(0.5 * (Z.local + Yi.local))
         delta = float(frobenius_norm(Y.with_local(Ynew.local - Y.local)))
@@ -269,11 +269,11 @@ def hpd_square_root(A: DistMatrix, uplo: str = "L", nb: int | None = None,
     (``El::HPSDSquareRoot`` analog): Z diag(sqrt(w)) Z^H."""
     from ..blas.level1 import diagonal_scale
     from .spectral import herm_eig
-    w, Z = herm_eig(A, uplo, vectors=True, nb=nb, precision=precision)
+    w, Z = herm_eig(A, uplo, vectors=True, nb=nb, precision=_hi(precision))
     sw = jnp.sqrt(jnp.clip(w, 0, None)).astype(A.dtype)
     d = DistMatrix(sw[:, None], (w.shape[0], 1), STAR, STAR, 0, 0, A.grid)
     Zs = diagonal_scale("R", d, Z)
-    return gemm(Zs, Z, orient_b="C", nb=nb, precision=precision)
+    return gemm(Zs, Z, orient_b="C", nb=nb, precision=_hi(precision))
 
 
 # ---------------------------------------------------------------------
@@ -308,7 +308,7 @@ def _dc_eig(A: DistMatrix, vectors: bool, nb, precision, base: int,
     for attempt in range(3):
         As = shift_diagonal(A, -sigma)
         # U = sign(A - sigma I) via QDWH polar (Hermitian => polar == sign)
-        U, _H = polar(As, nb=nb, precision=precision)
+        U, _H = polar(As, nb=nb, precision=_hi(precision))
         # projector onto the eigenspace below sigma: P = (I - U)/2
         P = shift_diagonal(U.with_local(-0.5 * U.local), 0.5)
         k = int(round(float(jnp.real(dm_trace(P)))))
@@ -334,12 +334,12 @@ def _dc_eig(A: DistMatrix, vectors: bool, nb, precision, base: int,
     from ..core.distmatrix import from_global
     Gd = from_global(G.astype(np.dtype(_real_dtype(A.dtype))), MC, MR,
                      grid=g).astype(A.dtype)
-    Y = gemm(P, Gd, nb=nb, precision=precision)
-    Qp, tau = qr(Y, nb=nb, precision=precision)
+    Y = gemm(P, Gd, nb=nb, precision=_hi(precision))
+    Qp, tau = qr(Y, nb=nb, precision=_hi(precision))
     # C = Q^H A Q  (two packed-reflector applications + a transposition)
-    T1 = apply_q(Qp, tau, A, orient="C", nb=nb, precision=precision)
+    T1 = apply_q(Qp, tau, A, orient="C", nb=nb, precision=_hi(precision))
     T2 = redistribute(transpose_dist(T1, conj=True), MC, MR)
-    T3 = apply_q(Qp, tau, T2, orient="C", nb=nb, precision=precision)
+    T3 = apply_q(Qp, tau, T2, orient="C", nb=nb, precision=_hi(precision))
     C = redistribute(transpose_dist(T3, conj=True), MC, MR)
     A1 = _hermitianize(interior_view(C, (0, k), (0, k)))
     A2 = _hermitianize(interior_view(C, (k, n), (k, n)))
@@ -351,7 +351,7 @@ def _dc_eig(A: DistMatrix, vectors: bool, nb, precision, base: int,
     BD = _blank(n, n, A)
     BD = interior_update(BD, Z1, (0, 0))
     BD = interior_update(BD, Z2, (k, k))
-    Z = apply_q(Qp, tau, BD, orient="N", nb=nb, precision=precision)
+    Z = apply_q(Qp, tau, BD, orient="N", nb=nb, precision=_hi(precision))
     return w, Z
 
 

@@ -43,7 +43,7 @@ from ..core.view import view, update_view
 from ..redist.engine import redistribute
 from ..blas.level1 import make_symmetric
 from ..blas.level3 import _blocksize, _check_mcmr, trsm
-from .lu import permute_rows, _update_cols_lt
+from .lu import permute_rows, _update_cols_lt, _hi
 
 _ALPHA = (1.0 + math.sqrt(17.0)) / 8.0
 
@@ -247,7 +247,7 @@ def ldl(A: DistMatrix, uplo: str = "L", conjugate: bool | None = None,
         W2H_mr = redistribute(DistMatrix(_c(W2).T, (nbw, nt), STAR, STAR,
                                          0, 0, g), STAR, MR)
         A22 = view(full, rows=(e_col, m), cols=(e_col, m))
-        upd = jnp.matmul(L2_mc.local, W2H_mr.local, precision=precision)
+        upd = jnp.matmul(L2_mc.local, W2H_mr.local, precision=_hi(precision))
         full = update_view(full, A22.with_local(A22.local - upd.astype(A.dtype)),
                            rows=(e_col, m), cols=(e_col, m))
     d = jnp.concatenate(d_parts)
@@ -296,9 +296,9 @@ def ldl_solve_after(Lp: DistMatrix, d, e, perm, B: DistMatrix,
     P^T L D L^H P X = B."""
     orient = "C" if conjugate else "T"
     Bp = permute_rows(B, perm)
-    Y = trsm("L", "L", "N", Lp, Bp, unit=True, nb=nb, precision=precision)
+    Y = trsm("L", "L", "N", Lp, Bp, unit=True, nb=nb, precision=_hi(precision))
     Z = _block_diag_solve(d, e, Y, conjugate)
-    X = trsm("L", "L", orient, Lp, Z, unit=True, nb=nb, precision=precision)
+    X = trsm("L", "L", orient, Lp, Z, unit=True, nb=nb, precision=_hi(precision))
     return permute_rows(X, perm, inverse=True)
 
 
@@ -306,18 +306,18 @@ def symmetric_solve(A: DistMatrix, B: DistMatrix, uplo: str = "L",
                     nb: int | None = None, precision=None) -> DistMatrix:
     """Solve A X = B for symmetric A via pivoted LDL^T
     (``El::SymmetricSolve``)."""
-    Lp, d, e, perm = ldl(A, uplo, conjugate=False, nb=nb, precision=precision)
+    Lp, d, e, perm = ldl(A, uplo, conjugate=False, nb=nb, precision=_hi(precision))
     return ldl_solve_after(Lp, d, e, perm, B, conjugate=False, nb=nb,
-                           precision=precision)
+                           precision=_hi(precision))
 
 
 def hermitian_solve(A: DistMatrix, B: DistMatrix, uplo: str = "L",
                     nb: int | None = None, precision=None) -> DistMatrix:
     """Solve A X = B for Hermitian A via pivoted LDL^H
     (``El::HermitianSolve``)."""
-    Lp, d, e, perm = ldl(A, uplo, conjugate=True, nb=nb, precision=precision)
+    Lp, d, e, perm = ldl(A, uplo, conjugate=True, nb=nb, precision=_hi(precision))
     return ldl_solve_after(Lp, d, e, perm, B, conjugate=True, nb=nb,
-                           precision=precision)
+                           precision=_hi(precision))
 
 
 def inertia(d, e):

@@ -30,11 +30,22 @@ from ..core.view import view, update_view
 from ..redist.engine import redistribute
 from ..blas.level3 import _blocksize, _check_mcmr, trsm
 
-#: row-swap strategy for the p == 1 path: "full" (gather whole trailing
-#: block, contiguous writeback) or "moved" (scatter only displaced rows).
-_SWAP_MODE = "full"
-#: chunk-width ladder for the replicated panel factorization.
-_INNERS = (256, 32)
+#: chunk-width ladder for the replicated panel factorization.  A/B-measured
+#: on v5e at n=16384 nb=2048 (perf/ab_harness.py, same-process roofline
+#: brackets): (512,64) 8.18/7.34 TFLOP/s across two runs vs (256,32) 6.53,
+#: (256,64) 6.89, (1024,128) 6.92, (512,64,16) 4.89, (768,96) 7.46.
+_INNERS = (512, 64)
+
+
+def _hi(precision):
+    """Precision policy of the lapack layer: with ``precision=None`` every
+    matmul in a factorization/reduction driver runs at full f32
+    accumulation (``Precision.HIGHEST``), matching the reference's f32
+    BLAS semantics -- the default (bf16-input) matmul precision costs
+    ~1e-2-level factor error on TPU, a silent accuracy downgrade.  An
+    explicitly passed precision (including ``lax.Precision.DEFAULT`` for
+    bf16-MXU throughput on the trailing updates) is honored unchanged."""
+    return precision if precision is not None else lax.Precision.HIGHEST
 
 
 # ---------------------------------------------------------------------
@@ -70,19 +81,6 @@ def _storage_row(i, r: int, lr: int):
     if r == 1:
         return i
     return (i % r) * lr + i // r
-
-
-def _apply_swaps_storage(A: DistMatrix, T, pstep) -> DistMatrix:
-    """Apply a composed row permutation ``pstep`` (full-m vector) to A's
-    rows at the positions ``T`` (a gather + scatter of |T| storage rows;
-    lu() passes the whole trailing range [s, m))."""
-    content = pstep[T]
-    r, lr = A.col_stride, A.local_rows
-    sidx = _storage_row(T, r, lr)
-    gsrc = _storage_row(content, r, lr)
-    stor = A.local
-    rows = jnp.take(stor, gsrc, axis=0)
-    return A.with_local(stor.at[sidx].set(rows))
 
 
 def _apply_swaps_moved(A: DistMatrix, T, S, valid) -> DistMatrix:
@@ -189,7 +187,8 @@ def _unit_lower_inv(L11, nbw: int, precision=None, bs: int = 256):
         if s > 0:
             corr = jnp.matmul(
                 Likk, jnp.matmul(L11[s:e, :s], Li[:s, :s],
-                                 precision=precision), precision=precision)
+                                 precision=_hi(precision)),
+                precision=_hi(precision))
             Li = Li.at[s:e, :s].set(-corr.astype(dt))
         Li = Li.at[s:e, s:e].set(Likk)
     return Li
@@ -228,21 +227,15 @@ def _local_lu(A: DistMatrix, nb: int | None, precision):
         nbw = e - s
         Pf, pperm = _panel_lu(a[s:, s:e], nbw, precision)
         perm = perm.at[s:].set(jnp.take(perm[s:], pperm, axis=0))
-        if _SWAP_MODE == "moved":
-            # swap only the rows the panel permutation displaced (<= 2*nbw)
-            idx, src = _moved_rows(pperm, nbw)
-            rows = jnp.take(a[s:], jnp.clip(src, 0, m - s - 1), axis=0)
-            a = a.at[jnp.asarray(s) + idx].set(rows, mode="drop")
-        else:
-            # full trailing-block gather + contiguous writeback (TPU scatters
-            # of dynamic row sets benchmark SLOWER than this full gather)
-            a = a.at[s:].set(jnp.take(a[s:], pperm, axis=0))
+        # full trailing-block gather + contiguous writeback (TPU scatters
+        # of dynamic row sets benchmark SLOWER than this full gather)
+        a = a.at[s:].set(jnp.take(a[s:], pperm, axis=0))
         a = a.at[s:, s:e].set(Pf)
         if e < n:
             Li11 = _unit_lower_inv(jnp.tril(Pf[:nbw], -1)
                                    + jnp.eye(nbw, dtype=a.dtype),
                                    nbw, precision)
-            U1n = jnp.matmul(Li11, a[s:e, e:], precision=precision
+            U1n = jnp.matmul(Li11, a[s:e, e:], precision=_hi(precision)
                              ).astype(a.dtype)
             a = a.at[s:e, e:].set(U1n)
             if e < m:
@@ -297,7 +290,7 @@ def lu(A: DistMatrix, nb: int | None = None, precision=None):
                                    + jnp.eye(nbw, dtype=Pf.dtype),
                                    nbw, precision)
             A1n = redistribute(view(A, rows=(s, e), cols=(s, n)), STAR, VR)
-            u1n = jnp.matmul(Li11, A1n.local, precision=precision
+            u1n = jnp.matmul(Li11, A1n.local, precision=_hi(precision)
                              ).astype(Pf.dtype)
             U1n = DistMatrix(u1n, (nbw, n - s), STAR, VR, 0, 0, g)
             U1n_mr = redistribute(U1n, STAR, MR)

@@ -31,7 +31,7 @@ from ..core.distmatrix import DistMatrix
 from ..core.view import view, update_view
 from ..redist.engine import redistribute
 from ..blas.level3 import _blocksize, _check_mcmr, trsm
-from .lu import _update_cols_lt, _update_cols_ge
+from .lu import _update_cols_lt, _update_cols_ge, _hi
 
 
 # ---------------------------------------------------------------------
@@ -136,9 +136,9 @@ def qr(A: DistMatrix, nb: int | None = None, precision=None):
             V_mc = redistribute(V_ss, MC, STAR)
             A2 = view(A, rows=(s, m), cols=(s, n))
             W = jnp.matmul(jnp.conj(V_mc.local).T, A2.local,
-                           precision=precision)          # [STAR,MR] storage
-            W = jnp.matmul(jnp.conj(T).T, W, precision=precision)
-            upd = jnp.matmul(V_mc.local, W, precision=precision)
+                           precision=_hi(precision))          # [STAR,MR] storage
+            W = jnp.matmul(jnp.conj(T).T, W, precision=_hi(precision))
+            upd = jnp.matmul(V_mc.local, W, precision=_hi(precision))
             A = _update_cols_ge(A, A2.with_local(A2.local - upd.astype(A.dtype)),
                                 (s, m), (s, n), e)
     return A, jnp.concatenate(taus) if taus else jnp.zeros((0,), A.dtype)
@@ -171,9 +171,9 @@ def apply_q(Ap: DistMatrix, tau, B: DistMatrix, orient: str = "N",
         V_ss = DistMatrix(V, (m - s, nbw), STAR, STAR, 0, 0, g)
         V_mc = redistribute(V_ss, MC, STAR)
         B2 = view(B, rows=(s, m))
-        W = jnp.matmul(jnp.conj(V_mc.local).T, B2.local, precision=precision)
-        W = jnp.matmul(Tm, W, precision=precision)
-        upd = jnp.matmul(V_mc.local, W, precision=precision)
+        W = jnp.matmul(jnp.conj(V_mc.local).T, B2.local, precision=_hi(precision))
+        W = jnp.matmul(Tm, W, precision=_hi(precision))
+        upd = jnp.matmul(V_mc.local, W, precision=_hi(precision))
         B = update_view(B, B2.with_local(B2.local - upd.astype(B.dtype)),
                         rows=(s, m))
     return B
@@ -184,7 +184,7 @@ def explicit_q(Ap: DistMatrix, tau, nb: int | None = None,
     """The m x m unitary Q as a DistMatrix (``qr::ExplicitUnitary``)."""
     from ..matrices.basic import identity
     I = identity(Ap.gshape[0], grid=Ap.grid, dtype=Ap.dtype)
-    return apply_q(Ap, tau, I, orient="N", nb=nb, precision=precision)
+    return apply_q(Ap, tau, I, orient="N", nb=nb, precision=_hi(precision))
 
 
 def least_squares(A: DistMatrix, B: DistMatrix, nb: int | None = None,
@@ -200,11 +200,11 @@ def least_squares(A: DistMatrix, B: DistMatrix, nb: int | None = None,
     m, n = A.gshape
     if m < n:
         raise ValueError("least_squares requires m >= n (tall)")
-    Ap, tau = qr(A, nb=nb, precision=precision)
-    Y = apply_q(Ap, tau, B, orient="C", nb=nb, precision=precision)
+    Ap, tau = qr(A, nb=nb, precision=_hi(precision))
+    Y = apply_q(Ap, tau, B, orient="C", nb=nb, precision=_hi(precision))
     R = make_trapezoidal(interior_view(Ap, (0, n), (0, n)), "U")
     Y1 = interior_view(Y, (0, n), (0, B.gshape[1]))
-    return trsm("L", "U", "N", R, Y1, nb=nb, precision=precision)
+    return trsm("L", "U", "N", R, Y1, nb=nb, precision=_hi(precision))
 
 
 # ---------------------------------------------------------------------
@@ -330,7 +330,7 @@ def qr_col_piv(A: DistMatrix, nb: int | None = None, precision=None):
                                           g), MC, STAR)
             FH = redistribute(DistMatrix(jnp.conj(F).T, (nbw, n), STAR, STAR,
                                          0, 0, g), STAR, MR)
-            upd = jnp.matmul(Vmc.local, FH.local, precision=precision)
+            upd = jnp.matmul(Vmc.local, FH.local, precision=_hi(precision))
             Awork = update_view(Awork, strip.with_local(
                 strip.local - upd.astype(A.dtype)), rows=(s, m))
     jpvt = jnp.concatenate(jps)
@@ -372,7 +372,7 @@ def lq(A: DistMatrix, nb: int | None = None, precision=None):
     :func:`apply_q_lq` / :func:`explicit_l` to consume it."""
     from ..redist.engine import transpose_dist
     Ah = redistribute(transpose_dist(A, conj=True), MC, MR)
-    return qr(Ah, nb=nb, precision=precision)
+    return qr(Ah, nb=nb, precision=_hi(precision))
 
 
 def apply_q_lq(Ap: DistMatrix, tau, B: DistMatrix, orient: str = "N",
@@ -380,7 +380,7 @@ def apply_q_lq(Ap: DistMatrix, tau, B: DistMatrix, orient: str = "N",
     """B := Q B ('N') or Q^H B ('C') with Q the LQ unitary (Q = Q_r^H of
     the underlying adjoint-QR)."""
     flip = "C" if orient == "N" else "N"
-    return apply_q(Ap, tau, B, orient=flip, nb=nb, precision=precision)
+    return apply_q(Ap, tau, B, orient=flip, nb=nb, precision=_hi(precision))
 
 
 def explicit_l(Ap: DistMatrix) -> DistMatrix:

@@ -49,6 +49,7 @@ from ..redist.interior import interior_view, interior_update, _blank
 from ..blas.level1 import (get_diagonal, shift_diagonal, frobenius_norm,
                            make_trapezoidal, diagonal_scale, _global_indices)
 from ..blas.level3 import _check_mcmr, _blocksize, gemm
+from .lu import _hi
 from .funcs import sign as _matrix_sign
 from .qr import qr, apply_q
 from ..core.view import view, update_view
@@ -104,7 +105,7 @@ def _sdc(A: DistMatrix, base: int, nb, precision, seed: int, depth: int = 0):
             As = shift_diagonal(A, -jnp.asarray(sigma, A.dtype))
             phase = jnp.asarray(np.exp(-1j * theta), A.dtype)
             S = _matrix_sign(As.with_local(phase * As.local), nb=nb,
-                             precision=precision)
+                             precision=_hi(precision))
         except FloatingPointError:
             continue
         P = shift_diagonal(S.with_local(-0.5 * S.local), 0.5)
@@ -116,11 +117,11 @@ def _sdc(A: DistMatrix, base: int, nb, precision, seed: int, depth: int = 0):
             continue
         G = rng.normal(size=(n, k)) + 1j * rng.normal(size=(n, k))
         Gd = from_global(G.astype(np.dtype(A.dtype)), MC, MR, grid=g)
-        Y = gemm(P, Gd, nb=nb, precision=precision)
-        Qp, tau = qr(Y, nb=nb, precision=precision)
-        T1_ = apply_q(Qp, tau, A, orient="C", nb=nb, precision=precision)
+        Y = gemm(P, Gd, nb=nb, precision=_hi(precision))
+        Qp, tau = qr(Y, nb=nb, precision=_hi(precision))
+        T1_ = apply_q(Qp, tau, A, orient="C", nb=nb, precision=_hi(precision))
         T2_ = redistribute(transpose_dist(T1_, conj=True), MC, MR)
-        T3_ = apply_q(Qp, tau, T2_, orient="C", nb=nb, precision=precision)
+        T3_ = apply_q(Qp, tau, T2_, orient="C", nb=nb, precision=_hi(precision))
         C = redistribute(transpose_dist(T3_, conj=True), MC, MR)
         # accept only a numerically clean split: the rotated (2,1) block
         # must be negligible (an unconverged sign near the line leaves mass
@@ -138,8 +139,8 @@ def _sdc(A: DistMatrix, base: int, nb, precision, seed: int, depth: int = 0):
     C12 = interior_view(C, (0, k), (k, n))
     Ta, Qa = _sdc(A11, base, nb, precision, 2 * seed + 1, depth + 1)
     Tb, Qb = _sdc(A22, base, nb, precision, 2 * seed + 2, depth + 1)
-    T12 = gemm(gemm(Qa, C12, orient_a="C", nb=nb, precision=precision), Qb,
-               nb=nb, precision=precision)
+    T12 = gemm(gemm(Qa, C12, orient_a="C", nb=nb, precision=_hi(precision)), Qb,
+               nb=nb, precision=_hi(precision))
     T = _blank(n, n, A)
     T = interior_update(T, Ta, (0, 0))
     T = interior_update(T, T12, (0, k))
@@ -147,7 +148,7 @@ def _sdc(A: DistMatrix, base: int, nb, precision, seed: int, depth: int = 0):
     BD = _blank(n, n, A)
     BD = interior_update(BD, Qa, (0, 0))
     BD = interior_update(BD, Qb, (k, k))
-    Q = apply_q(Qp, tau, BD, orient="N", nb=nb, precision=precision)
+    Q = apply_q(Qp, tau, BD, orient="N", nb=nb, precision=_hi(precision))
     return make_trapezoidal(T, "U"), Q
 
 
@@ -210,7 +211,7 @@ def triang_eig(T: DistMatrix, nb: int | None = None, precision=None):
     # RHS: e_j per column -- the modified system keeps column j's coupling
     # T[i, j] x[j], so rows i < j see exactly (T - lambda_j)[:j,:j] x = -T[:j, j]
     B = shift_diagonal(_blank(n, n, T), 1)
-    X = multishift_trsm("U", "N", T, w, B, nb=nb, precision=precision,
+    X = multishift_trsm("U", "N", T, w, B, nb=nb, precision=_hi(precision),
                         diag_hook=hook)
     # normalize columns to unit 2-norm
     norms = _global_colnorms(X, n)
@@ -223,9 +224,9 @@ def eig(A: DistMatrix, base: int | None = None, nb: int | None = None,
         precision=None):
     """General (non-Hermitian) eigendecomposition via Schur + TriangEig
     (``El::Eig``): returns (w, V) with A V ~= V diag(w), unit columns."""
-    T, Q = schur(A, base=base, nb=nb, precision=precision)
-    w, Vt = triang_eig(T, nb=nb, precision=precision)
-    V = gemm(Q, Vt, nb=nb, precision=precision)
+    T, Q = schur(A, base=base, nb=nb, precision=_hi(precision))
+    w, Vt = triang_eig(T, nb=nb, precision=_hi(precision))
+    V = gemm(Q, Vt, nb=nb, precision=_hi(precision))
     # re-normalize (Q is unitary so norms are preserved up to rounding)
     return w, V
 
@@ -247,7 +248,7 @@ def pseudospectra(A: DistMatrix, re_window, im_window, nx: int = 20,
     if triangular:
         T = A.astype(_complex_dtype(A.dtype))
     else:
-        T, _Q = schur(A, base=base, nb=nb, precision=precision)
+        T, _Q = schur(A, base=base, nb=nb, precision=_hi(precision))
     xs = np.linspace(re_window[0], re_window[1], nx)
     ys = np.linspace(im_window[0], im_window[1], ny)
     Z = xs[None, :] + 1j * ys[:, None]
@@ -266,14 +267,14 @@ def pseudospectra(A: DistMatrix, re_window, im_window, nx: int = 20,
     for _ in range(iters):
         # y = (T - z)^{-1} v ; u = (T - z)^{-H} y : inverse iteration on the
         # Hermitian product; ||y|| after normalization estimates 1/sigma_min
-        Y = multishift_trsm("U", "N", T, shifts, V, nb=nb, precision=precision)
+        Y = multishift_trsm("U", "N", T, shifts, V, nb=nb, precision=_hi(precision))
         ny_ = colnorms(Y)
         dinv = DistMatrix(jnp.where(ny_ > 0, 1 / jnp.where(ny_ == 0, 1, ny_),
                                     0)[:, None].astype(T.dtype),
                           (k, 1), STAR, STAR, 0, 0, g)
         Yn = diagonal_scale("R", dinv, Y)
         U = multishift_trsm("U", "C", T, cshifts, Yn, nb=nb,
-                            precision=precision)
+                            precision=_hi(precision))
         nu = colnorms(U)
         est = jnp.sqrt(ny_ * nu)
         dinv2 = DistMatrix(jnp.where(nu > 0, 1 / jnp.where(nu == 0, 1, nu),

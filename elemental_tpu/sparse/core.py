@@ -205,6 +205,13 @@ class DistSparseMatrix:
 
 @jax.jit
 def _spmv(A: DistSparseMatrix, x: DistMultiVec, alpha) -> DistMultiVec:
+    """Footprint note: x is all-gathered fully replicated before the local
+    gather-multiply -- O(p * n * w) aggregate traffic and O(n * w) memory
+    per device.  The reference instead exchanges only the column support
+    per rank (``DistSparseMatrix::Multiply`` metadata); the all-gather is
+    the right TPU trade while n*w stays << HBM (w is 1..O(10) here), and
+    the per-support exchange (a ragged all_to_all) is the upgrade path if
+    a workload ever needs n beyond replicated-vector scale."""
     m, n = A.gshape
     g = A.grid
     w = x.width
