@@ -1,0 +1,214 @@
+"""A/B perf harness for the single-chip Cholesky/LU schedules.
+
+Runs several schedule variants IN ONE PROCESS on the real chip, bracketing
+each timing with a matmul roofline measurement so chip-weather is factored
+out per-variant (the r4 lesson: never land a "perf" change without a
+before/after pair).  Usage:
+
+    python perf/ab_harness.py chol     # Cholesky variants at N=32768
+    python perf/ab_harness.py lu       # LU variants at N=16384
+    python perf/ab_harness.py phases   # LU phase breakdown (panel vs rest)
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache_tpu")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+import importlib                                              # noqa: E402
+
+import elemental_tpu as el                                    # noqa: E402
+
+chol_mod = importlib.import_module("elemental_tpu.lapack.cholesky")
+lu_mod = importlib.import_module("elemental_tpu.lapack.lu")
+
+HI = jax.lax.Precision.HIGHEST
+
+
+def _min3(fn, reps=3):
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+LAT = None
+_ROOF_R = None
+
+
+def roofline():
+    global LAT, _ROOF_R
+    if LAT is None:
+        tiny = jax.jit(lambda x: x + 1.0)
+        t = jnp.zeros(())
+        float(tiny(t))
+        LAT = _min3(lambda: float(tiny(t)))
+    n = 8192
+    if _ROOF_R is None:
+        _ROOF_R = jax.random.normal(jax.random.PRNGKey(9), (n, n), jnp.float32)
+    mm = jax.jit(lambda x: jnp.matmul(x, x, precision=HI))
+    float(mm(_ROOF_R)[0, 0])
+    dt = max(_min3(lambda: float(mm(_ROOF_R)[0, 0])) - LAT, 1e-9)
+    return 2 * n ** 3 / dt / 1e12
+
+
+def timed(make_input, step, reps=3):
+    out = step(make_input())
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(reps):
+        A = make_input()
+        float(jax.tree_util.tree_leaves(A)[0].ravel()[0])
+        t0 = time.perf_counter()
+        out = step(A)
+        float(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+        times.append(time.perf_counter() - t0)
+    del out
+    return max(min(times) - LAT, 1e-9)
+
+
+def report(name, tflops, roof):
+    print(f"{name:40s} {tflops:8.3f} TFLOP/s   roof {roof:6.2f}"
+          f"   norm {100 * tflops / roof:5.1f}%", flush=True)
+
+
+def run_chol():
+    n, grid = 32768, el.Grid([jax.devices()[0]])
+
+    @jax.jit
+    def gen():
+        G = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.float32)
+        return jnp.matmul(G, G.T) / n + n * jnp.eye(n, dtype=jnp.float32)
+
+    def wrap(a):
+        return el.DistMatrix(a, (n, n), el.MC, el.MR, 0, 0, grid)
+
+    from jax import lax
+
+    def native_potrf_inv(D, precision, bs=512):
+        w = D.shape[0]
+        d = jnp.tril(D)
+        d = d + jnp.conj(jnp.tril(d, -1)).T
+        L = jnp.linalg.cholesky(d)
+        Li = lax.linalg.triangular_solve(L, jnp.eye(w, dtype=D.dtype),
+                                         left_side=True, lower=True)
+        return L, Li
+
+    orig = chol_mod._potrf_inv
+    variants = []
+    for nb in (2048, 4096):
+        variants.append((f"r4 _potrf_inv bs512 nb={nb}", orig, nb))
+    variants.append(("native potrf+trsm-inv nb=2048", native_potrf_inv, 2048))
+    variants.append(("_potrf_inv bs1024 nb=4096",
+                     lambda D, p, bs=1024: orig(D, p, bs), 4096))
+    variants.append(("_potrf_inv bs1024 nb=2048",
+                     lambda D, p, bs=1024: orig(D, p, bs), 2048))
+
+    for name, fn, nb in variants:
+        chol_mod._potrf_inv = fn
+        step = jax.jit(lambda a, _nb=nb: el.cholesky(a, nb=_nb,
+                                                     precision=HI).local,
+                       donate_argnums=0)
+        r0 = roofline()
+        dt = timed(lambda: wrap(gen()), step)
+        r1 = roofline()
+        report(name, (n ** 3 / 3) / dt / 1e12, 0.5 * (r0 + r1))
+        del step
+    chol_mod._potrf_inv = orig
+
+
+def run_lu():
+    n, grid = 16384, el.Grid([jax.devices()[0]])
+
+    def wrap(a):
+        return el.DistMatrix(a, (n, n), el.MC, el.MR, 0, 0, grid)
+
+    gen = jax.jit(lambda: jax.random.normal(jax.random.PRNGKey(1), (n, n),
+                                            jnp.float32))
+
+    orig_inners = lu_mod._INNERS
+    cases = []
+    for inners in ((512, 64), (256, 64), (512, 64), (1024, 128),
+                   (512, 64, 16), (768, 96)):
+        cases.append((f"inners={inners} nb=2048", inners, 2048))
+    cases.append((f"inners=(512,64) nb=3072", (512, 64), 3072))
+
+    for name, inners, nb in cases:
+        lu_mod._INNERS = inners
+        lufn = jax.jit(lambda a, _nb=nb: tuple(el.lu(a, nb=_nb,
+                                                     precision=HI)),
+                       donate_argnums=0)
+
+        def step(A):
+            LU, perm = lufn(A)
+            return LU.local, perm
+
+        r0 = roofline()
+        dt = timed(lambda: wrap(gen()), step)
+        r1 = roofline()
+        report(name, (2 * n ** 3 / 3) / dt / 1e12, 0.5 * (r0 + r1))
+        del lufn
+    lu_mod._INNERS = orig_inners
+
+
+def run_phases():
+    """Time the LU panel factorization alone vs a full matmul of the same
+    trailing update shape, to see where the 2/3 n^3 budget goes."""
+    m, nbw = 16384, 2048
+
+    def sync(x):
+        return float(jax.tree_util.tree_leaves(x)[0].ravel()[0])
+
+    P = jax.random.normal(jax.random.PRNGKey(4), (m, nbw), jnp.float32)
+    for inners in ((256, 32), (512, 64), (128, 16), (64,), (1024, 128, 16)):
+        pan = jax.jit(lambda p, _i=inners: lu_mod._panel_lu(p, nbw, HI, _i))
+        sync(pan(P))
+        dt = max(_min3(lambda: sync(pan(P))) - LAT, 1e-9)
+        print(f"panel m={m} nbw={nbw} inners={inners}: {dt*1e3:8.2f} ms",
+              flush=True)
+    # trailing update matmul for the first panel: (m-nbw, nbw) @ (nbw, m-nbw)
+    A = jax.random.normal(jax.random.PRNGKey(5), (m - nbw, nbw), jnp.float32)
+    B = jax.random.normal(jax.random.PRNGKey(6), (nbw, m - nbw), jnp.float32)
+    mm = jax.jit(lambda a, b: jnp.matmul(a, b, precision=HI))
+    sync(mm(A, B))
+    dt = max(_min3(lambda: sync(mm(A, B))) - LAT, 1e-9)
+    fl = 2 * (m - nbw) ** 2 * nbw
+    print(f"trailing mm {m-nbw}x{nbw}x{m-nbw}: {dt*1e3:8.2f} ms "
+          f"({fl/dt/1e12:.2f} TFLOP/s)", flush=True)
+    # full-trailing row gather (the swap cost): take + writeback of m x m
+    G = jax.random.normal(jax.random.PRNGKey(7), (m, m), jnp.float32)
+    pp = jnp.arange(m)[::-1]
+    gat = jax.jit(lambda a: a.at[0:].set(jnp.take(a, pp, axis=0)),
+                  donate_argnums=0)
+    sync(gat(G))
+    G = jax.random.normal(jax.random.PRNGKey(7), (m, m), jnp.float32)
+    sync(G)
+    t0 = time.perf_counter()
+    sync(gat(G))
+    print(f"full {m}x{m} row-permute: "
+          f"{(time.perf_counter()-t0-LAT)*1e3:8.2f} ms", flush=True)
+    print(f"roofline now: {roofline():.2f}", flush=True)
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1] if len(sys.argv) > 1 else "chol"
+    tiny = jax.jit(lambda x: x + 1.0)
+    t = jnp.zeros(())
+    float(tiny(t))
+    LAT = _min3(lambda: float(tiny(t)))
+    print(f"device {jax.devices()[0].device_kind}, rt latency {LAT*1e3:.2f} ms",
+          flush=True)
+    if mode == "chol":
+        run_chol()
+    elif mode == "lu":
+        run_lu()
+    else:
+        run_phases()
