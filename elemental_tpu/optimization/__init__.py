@@ -11,3 +11,6 @@ from .soc import (socp, make_cone_layout, soc_dets, soc_apply, soc_inverse,
 from .prox import (soft_threshold, svt, clip, frobenius_prox,
                    hinge_loss_prox, logistic_prox)
 from .models import bp, lav, nnls, lasso, svm, rpca
+from .equilibrate import (ruiz_equil, geom_equil, symmetric_ruiz_equil,
+                          row_col_maxabs)
+from .affine import lp_affine, qp_affine, socp_affine, ruiz_equil_stacked

@@ -1,0 +1,124 @@
+"""Ruiz + geometric matrix equilibration for the IPMs.
+
+Reference: Elemental ``src/optimization/util/`` equilibration helpers
+(``El::RuizEquil``, ``El::GeomEquil``, ``El::SymmetricRuizEquil``), the
+mandatory first step of every upstream IPM solve (SURVEY.md §4.6): badly
+scaled (A, b, c) -- rows/columns spanning orders of magnitude, the NORMAL
+case in practice -- stall Mehrotra or lose digits in the normal-equations
+Cholesky, so A is rescaled to D_r A D_c with near-unit row/column norms
+first and the solution mapped back afterwards.
+
+Scale vectors are replicated (they are O(m + n) against the O(mn)
+distributed operand, the same subordinate role as the SOC member vectors);
+the row/column max reductions run on the storage array (each global entry
+exactly once, padding zeros ignored by the max since |entries| >= 0).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dist import STAR
+from ..core.distmatrix import DistMatrix
+from ..blas.level1 import _global_indices, diagonal_scale
+
+
+def _wrap(v, grid):
+    """Replicated (k,) vector -> (k,1) [STAR,STAR] for diagonal_scale."""
+    return DistMatrix(v.reshape(-1, 1), (v.shape[0], 1), STAR, STAR, 0, 0,
+                      grid)
+
+
+def row_col_maxabs(A: DistMatrix):
+    """Per-row and per-column max |A_ij| as replicated (m,), (n,) vectors."""
+    m, n = A.gshape
+    I, J = _global_indices(A)
+    a = jnp.abs(A.local)
+    valid = (I[:, None] < m) & (J[None, :] < n)
+    a = jnp.where(valid, a, 0)
+    rloc = jnp.max(a, axis=1)                       # per storage row
+    cloc = jnp.max(a, axis=0)
+    rowm = jnp.zeros((m,), a.dtype).at[jnp.clip(I, 0, m - 1)].max(rloc)
+    colm = jnp.zeros((n,), a.dtype).at[jnp.clip(J, 0, n - 1)].max(cloc)
+    return rowm, colm
+
+
+def row_col_minabs(A: DistMatrix):
+    """Per-row/column min of the NONZERO |A_ij| (zeros treated as absent;
+    all-zero rows/cols report inf)."""
+    m, n = A.gshape
+    I, J = _global_indices(A)
+    a = jnp.abs(A.local)
+    valid = (I[:, None] < m) & (J[None, :] < n) & (a > 0)
+    a = jnp.where(valid, a, jnp.inf)
+    rloc = jnp.min(a, axis=1)
+    cloc = jnp.min(a, axis=0)
+    rowm = jnp.full((m,), jnp.inf, a.dtype).at[jnp.clip(I, 0, m - 1)].min(rloc)
+    colm = jnp.full((n,), jnp.inf, a.dtype).at[jnp.clip(J, 0, n - 1)].min(cloc)
+    return rowm, colm
+
+
+def ruiz_equil(A: DistMatrix, iters: int = 6):
+    """Ruiz iteration (``El::RuizEquil``): repeatedly scale rows and columns
+    by 1/sqrt(max-abs), converging to unit row/column inf-norms.
+
+    Returns (A_scaled = D_r A D_c, d_r, d_c) with the scale vectors
+    replicated; recover original-variable quantities via x = D_c x~,
+    y = D_r y~ (LP convention: A~x~=b~ with b~ = D_r b, c~ = D_c c)."""
+    m, n = A.gshape
+    dt = jnp.real(jnp.zeros((), A.dtype)).dtype
+    d_r = jnp.ones((m,), dt)
+    d_c = jnp.ones((n,), dt)
+    As = A
+    for _ in range(iters):
+        rowm, colm = row_col_maxabs(As)
+        sr = 1.0 / jnp.sqrt(jnp.maximum(rowm, 1e-30))
+        sc = 1.0 / jnp.sqrt(jnp.maximum(colm, 1e-30))
+        # all-zero rows/cols keep scale 1 (nothing to normalize)
+        sr = jnp.where(rowm > 0, sr, 1.0)
+        sc = jnp.where(colm > 0, sc, 1.0)
+        As = diagonal_scale("L", _wrap(sr, A.grid), As)
+        As = diagonal_scale("R", _wrap(sc, A.grid), As)
+        d_r = d_r * sr
+        d_c = d_c * sc
+    return As, d_r, d_c
+
+
+def geom_equil(A: DistMatrix, iters: int = 3):
+    """Geometric-mean equilibration (``El::GeomEquil``): scale by
+    1/sqrt(max * min_nonzero) per row/column -- centers the magnitude
+    RANGE rather than the top, the upstream alternative for matrices with
+    wide but structured dynamic range."""
+    m, n = A.gshape
+    dt = jnp.real(jnp.zeros((), A.dtype)).dtype
+    d_r = jnp.ones((m,), dt)
+    d_c = jnp.ones((n,), dt)
+    As = A
+    for _ in range(iters):
+        rmax, cmax = row_col_maxabs(As)
+        rmin, cmin = row_col_minabs(As)
+        sr = jnp.where((rmax > 0) & jnp.isfinite(rmin),
+                       1.0 / jnp.sqrt(jnp.maximum(rmax * rmin, 1e-30)), 1.0)
+        sc = jnp.where((cmax > 0) & jnp.isfinite(cmin),
+                       1.0 / jnp.sqrt(jnp.maximum(cmax * cmin, 1e-30)), 1.0)
+        As = diagonal_scale("L", _wrap(sr, A.grid), As)
+        As = diagonal_scale("R", _wrap(sc, A.grid), As)
+        d_r = d_r * sr
+        d_c = d_c * sc
+    return As, d_r, d_c
+
+
+def symmetric_ruiz_equil(Q: DistMatrix, iters: int = 6):
+    """Symmetric variant (``El::SymmetricRuizEquil``): one scale vector d
+    with Q~ = D Q D (preserves symmetry/definiteness)."""
+    n = Q.gshape[0]
+    dt = jnp.real(jnp.zeros((), Q.dtype)).dtype
+    d = jnp.ones((n,), dt)
+    Qs = Q
+    for _ in range(iters):
+        rowm, _ = row_col_maxabs(Qs)
+        s = jnp.where(rowm > 0,
+                      1.0 / jnp.sqrt(jnp.maximum(rowm, 1e-30)), 1.0)
+        Qs = diagonal_scale("L", _wrap(s, Q.grid), Qs)
+        Qs = diagonal_scale("R", _wrap(s, Q.grid), Qs)
+        d = d * s
+    return Qs, d

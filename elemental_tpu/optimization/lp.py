@@ -21,7 +21,8 @@ import numpy as np
 from ..core.dist import MC, MR
 from ..core.distmatrix import DistMatrix
 from ..redist.engine import redistribute, transpose_dist
-from ..blas.level1 import _valid_mask, shift_diagonal, diagonal_scale
+from ..blas.level1 import (_valid_mask, shift_diagonal, diagonal_scale,
+                           diagonal_solve)
 from ..blas.level3 import _check_mcmr, gemm
 from ..lapack.cholesky import cholesky, cholesky_solve_after
 from .util import MehrotraCtrl, max_step, safe_div
@@ -56,6 +57,25 @@ def lp(A: DistMatrix, b: DistMatrix, c: DistMatrix,
     ctrl = ctrl or MehrotraCtrl()
     m, n = A.gshape
     g = A.grid
+
+    if ctrl.equilibrate:
+        # Ruiz first (El::RuizEquil): A~ = Dr A Dc, b~ = Dr b, c~ = Dc c;
+        # solve scaled, then x = Dc x~, y = Dr y~, z = Dc^{-1} z~.
+        from .equilibrate import ruiz_equil, _wrap
+        import dataclasses as _dc
+        As, d_r, d_c = ruiz_equil(A)
+        wr = _wrap(d_r.astype(b.dtype), g)
+        wc = _wrap(d_c.astype(c.dtype), g)
+        bs = diagonal_scale("L", wr, b)
+        cs = diagonal_scale("L", wc, c)
+        xs, ys, zs, info = lp(As, bs, cs,
+                              _dc.replace(ctrl, equilibrate=False), nb,
+                              precision)
+        x = diagonal_scale("L", wc, xs)
+        y = diagonal_scale("L", wr, ys)
+        z = diagonal_solve("L", wc, zs)
+        return x, y, z, info
+
     At = _tp(A)
     vm_x = _valid_mask(c)
     vm_y = _valid_mask(b)

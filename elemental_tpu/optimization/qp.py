@@ -43,6 +43,34 @@ def qp(Q: DistMatrix, c: DistMatrix, A: DistMatrix | None = None,
     n = Q.gshape[0]
     m = A.gshape[0] if A is not None else 0
     g = Q.grid
+
+    if ctrl.equilibrate:
+        # symmetric Ruiz on Q fixes the column scale Dc (Q~ = Dc Q Dc,
+        # preserving symmetry/PSD); A gets the shared Dc plus its own row
+        # scale.  x = Dc x~, y = Dr y~, z = Dc^{-1} z~.
+        from .equilibrate import symmetric_ruiz_equil, row_col_maxabs, _wrap
+        from ..blas.level1 import diagonal_scale, diagonal_solve
+        import dataclasses as _dc
+        import jax.numpy as _jnp
+        Qs, d_c = symmetric_ruiz_equil(Q)
+        wc = _wrap(d_c.astype(c.dtype), g)
+        cs = diagonal_scale("L", wc, c)
+        ctrl2 = _dc.replace(ctrl, equilibrate=False)
+        if A is None:
+            xs, ys, zs, info = qp(Qs, cs, None, None, ctrl2, nb, precision)
+            return (diagonal_scale("L", wc, xs), ys,
+                    diagonal_solve("L", wc, zs), info)
+        As = diagonal_scale("R", wc, A)
+        rmax, _ = row_col_maxabs(As)
+        d_r = _jnp.where(rmax > 0,
+                         1.0 / _jnp.sqrt(_jnp.maximum(rmax, 1e-30)), 1.0)
+        wr = _wrap(d_r.astype(b.dtype), g)
+        As = diagonal_scale("L", wr, As)
+        bs = diagonal_scale("L", wr, b)
+        xs, ys, zs, info = qp(Qs, cs, As, bs, ctrl2, nb, precision)
+        return (diagonal_scale("L", wc, xs), diagonal_scale("L", wr, ys),
+                diagonal_solve("L", wc, zs), info)
+
     At = _tp(A) if A is not None else None
     vm_x = _valid_mask(c)
 

@@ -180,6 +180,42 @@ def socp(A: DistMatrix, b: DistMatrix, c: DistMatrix, orders_list,
     if orders.shape[0] != n:
         raise ValueError(f"cone sizes sum to {orders.shape[0]}, need {n}")
     g = A.grid
+
+    if ctrl.equilibrate:
+        # cone-aware Ruiz: the column scale is pooled UNIFORM within each
+        # cone (x = Dc x~ then preserves membership); rows of A scale
+        # freely.  y = Dr y~, z = Dc^{-1} z~.
+        from .equilibrate import row_col_maxabs, _wrap
+        from ..blas.level1 import diagonal_scale, diagonal_solve
+        import dataclasses as _dc
+        import jax.numpy as _jnp
+        As = A
+        d_r = np.ones(m)
+        d_c = np.ones(n)
+        starts = np.unique(first_inds)
+        for _ in range(4):
+            rmax, _cm = row_col_maxabs(As)
+            sr = np.asarray(_jnp.where(
+                rmax > 0, 1.0 / _jnp.sqrt(_jnp.maximum(rmax, 1e-30)), 1.0))
+            As = diagonal_scale("L", _wrap(_jnp.asarray(sr, A.dtype), g), As)
+            _rm, cmax = row_col_maxabs(As)
+            cmax = np.asarray(cmax)
+            pooled = np.maximum.reduceat(cmax, starts)[
+                np.searchsorted(starts, first_inds)]
+            sc = np.where(pooled > 0,
+                          1.0 / np.sqrt(np.maximum(pooled, 1e-30)), 1.0)
+            As = diagonal_scale("R", _wrap(_jnp.asarray(sc, A.dtype), g), As)
+            d_r *= sr
+            d_c *= sc
+        wr = _wrap(_jnp.asarray(d_r, b.dtype), g)
+        wc = _wrap(_jnp.asarray(d_c, c.dtype), g)
+        bs = diagonal_scale("L", wr, b)
+        cs = diagonal_scale("L", wc, c)
+        xs, ys, zs, info = socp(As, bs, cs, orders_list,
+                                _dc.replace(ctrl, equilibrate=False), nb,
+                                precision)
+        return (diagonal_scale("L", wc, xs), diagonal_scale("L", wr, ys),
+                diagonal_solve("L", wc, zs), info)
     At = _tp(A)
     e = soc_identity(first_inds, n)
     K = len(orders_list)

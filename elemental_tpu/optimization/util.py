@@ -27,6 +27,8 @@ class MehrotraCtrl:
     eta: float = 0.995          # fraction-to-the-boundary damping
     init_shift: float = 10.0    # Mehrotra initialization delta scaling
     print_progress: bool = False
+    equilibrate: bool = True    # Ruiz-equilibrate the data first
+                                # (El::RuizEquil, upstream's mandatory step)
 
 
 def safe_div(a, b):
