@@ -13,6 +13,9 @@ from .core.ctrl import (SignCtrl, PolarCtrl, HermitianEigCtrl, SVDCtrl,
                         SchurCtrl, PseudospecCtrl, LDLPivotCtrl, QRCtrl,
                         LeastSquaresCtrl)
 from .core.distmatrix import DistMatrix, from_global, to_global, zeros
+from .core.block import (BlockMatrix, block_from_global, block_from_array,
+                         block_to_global, block_to_cyclic, block_from_cyclic,
+                         as_elemental)
 from .core.multivec import (DistMultiVec, mv_from_global, mv_to_global,
                             mv_zeros, mv_axpy, mv_scale, mv_dot, mv_nrm2,
                             mv_remote_updates, mv_to_distmatrix,
@@ -50,7 +53,8 @@ from .redist.interior import interior_view, interior_update, vstack, hstack
 from .optimization import (MehrotraCtrl, lp, qp, socp, soft_threshold, svt,
                            bp, lav, nnls, lasso, svm, rpca,
                            lp_affine, qp_affine, socp_affine,
-                           ruiz_equil, geom_equil, symmetric_ruiz_equil)
+                           ruiz_equil, geom_equil, symmetric_ruiz_equil,
+                           lp_sparse, lav_sparse, bp_sparse)
 from .control import sylvester, lyapunov, riccati
 from .lapack.schur import schur, triang_eig, eig, pseudospectra
 from .lapack.props import (determinant, safe_determinant, hpd_determinant,

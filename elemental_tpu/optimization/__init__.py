@@ -14,3 +14,5 @@ from .models import bp, lav, nnls, lasso, svm, rpca
 from .equilibrate import (ruiz_equil, geom_equil, symmetric_ruiz_equil,
                           row_col_maxabs)
 from .affine import lp_affine, qp_affine, socp_affine, ruiz_equil_stacked
+from .sparse_ipm import (lp_sparse, lav_sparse, bp_sparse,
+                         sparse_ruiz_equil, sparse_to_coo)

@@ -1,0 +1,142 @@
+"""Sparse-operand IPM oracles (VERDICT r4 item 3).
+
+Reference style: the upstream sparse IPMs are exercised through the model
+drivers (``examples/optimization/LAV.cpp`` etc.) printing duality-gap
+convergence; here the oracles are scipy/HiGHS objective agreement plus
+the "Done" criterion: sparse LAV/BP on 10k x 5k operands converging to
+duality gap < 1e-6 on the 8-device mesh.
+"""
+import numpy as np
+import pytest
+import scipy.sparse as sp
+from scipy.optimize import linprog
+
+import elemental_tpu as el
+from elemental_tpu.core.multivec import mv_from_global, mv_to_global
+from elemental_tpu.sparse.core import dist_sparse_from_coo
+from elemental_tpu.optimization.util import MehrotraCtrl
+
+
+def _rand_sparse(rng, m, n, nnz):
+    rows = rng.integers(0, m, nnz)
+    cols = rng.integers(0, n, nnz)
+    vals = rng.normal(size=nnz)
+    return rows, cols, vals
+
+
+def test_lp_sparse_oracle(grid24):
+    rng = np.random.default_rng(0)
+    m, n, nnz = 40, 100, 400
+    rows, cols, vals = _rand_sparse(rng, m, n, nnz)
+    As = sp.coo_matrix((vals, (rows, cols)), shape=(m, n)).tocsr()
+    x0 = rng.uniform(0.5, 1.5, n)
+    b = As @ x0
+    c = As.T @ rng.normal(size=m) + rng.uniform(0.1, 2.0, n)
+    A = dist_sparse_from_coo(rows, cols, vals, m, n, grid=grid24,
+                             dtype=np.float64)
+    x, y, z, info = el.lp_sparse(
+        A, mv_from_global(b.reshape(-1, 1), grid=grid24),
+        mv_from_global(c.reshape(-1, 1), grid=grid24),
+        MehrotraCtrl(tol=1e-6, max_iters=60))
+    assert info["converged"], info
+    res = linprog(c, A_eq=As.toarray(), b_eq=b, bounds=[(0, None)] * n,
+                  method="highs")
+    assert res.status == 0
+    xg = np.asarray(mv_to_global(x)).ravel()
+    assert abs(c @ xg - res.fun) / (1 + abs(res.fun)) < 1e-5
+
+
+def test_lp_sparse_badly_scaled(grid24):
+    """Ruiz preprocessing (on triplets) handles 1e+-5 row scaling."""
+    rng = np.random.default_rng(1)
+    m, n, nnz = 30, 80, 320
+    rows, cols, vals = _rand_sparse(rng, m, n, nnz)
+    rsc = np.exp(rng.uniform(-5, 5, m))
+    vals = vals * rsc[rows]
+    As = sp.coo_matrix((vals, (rows, cols)), shape=(m, n)).tocsr()
+    x0 = rng.uniform(0.5, 1.5, n)
+    b = As @ x0
+    c = As.T @ rng.normal(size=m) + rng.uniform(0.1, 2.0, n)
+    A = dist_sparse_from_coo(rows, cols, vals, m, n, grid=grid24,
+                             dtype=np.float64)
+    x, y, z, info = el.lp_sparse(
+        A, mv_from_global(b.reshape(-1, 1), grid=grid24),
+        mv_from_global(c.reshape(-1, 1), grid=grid24),
+        MehrotraCtrl(tol=1e-6, max_iters=60))
+    assert info["converged"], info
+    res = linprog(c, A_eq=As.toarray(), b_eq=b, bounds=[(0, None)] * n,
+                  method="highs")
+    xg = np.asarray(mv_to_global(x)).ravel()
+    assert abs(c @ xg - res.fun) / (1 + abs(res.fun)) < 1e-4
+
+
+def test_bp_sparse_recovery(grid24):
+    """BP on a wide sparse operator recovers a sparse signal (classic
+    compressed-sensing oracle: the l1 minimizer matches HiGHS)."""
+    rng = np.random.default_rng(2)
+    m, n = 60, 160
+    rows, cols, vals = _rand_sparse(rng, m, n, 900)
+    As = sp.coo_matrix((vals, (rows, cols)), shape=(m, n)).tocsr()
+    xs = np.zeros(n)
+    sup = rng.choice(n, 6, replace=False)
+    xs[sup] = rng.normal(size=6) * 3
+    b = As @ xs
+    A = dist_sparse_from_coo(rows, cols, vals, m, n, grid=grid24,
+                             dtype=np.float64)
+    x, info = el.bp_sparse(A, mv_from_global(b.reshape(-1, 1), grid=grid24),
+                           MehrotraCtrl(tol=1e-6, max_iters=80))
+    assert info["converged"], info
+    xg = np.asarray(mv_to_global(x)).ravel()
+    assert np.linalg.norm(As @ xg - b) / np.linalg.norm(b) < 1e-5
+    # l1-objective oracle via HiGHS on the same split-variable LP
+    cc = np.ones(2 * n)
+    Aeq = sp.hstack([As, -As]).toarray()
+    res = linprog(cc, A_eq=Aeq, b_eq=b, bounds=[(0, None)] * (2 * n),
+                  method="highs")
+    assert abs(np.abs(xg).sum() - res.fun) / (1 + abs(res.fun)) < 1e-4
+
+
+def test_lav_sparse_small(grid24):
+    rng = np.random.default_rng(3)
+    m, n = 80, 30
+    rows, cols, vals = _rand_sparse(rng, m, n, 600)
+    As = sp.coo_matrix((vals, (rows, cols)), shape=(m, n)).tocsr()
+    xt = rng.normal(size=n)
+    b = As @ xt
+    out = rng.choice(m, 8, replace=False)
+    b[out] += rng.normal(size=8) * 20            # gross outliers
+    A = dist_sparse_from_coo(rows, cols, vals, m, n, grid=grid24,
+                             dtype=np.float64)
+    x, info = el.lav_sparse(A, mv_from_global(b.reshape(-1, 1), grid=grid24),
+                            MehrotraCtrl(tol=1e-6, max_iters=80))
+    assert info["converged"], info
+    xg = np.asarray(mv_to_global(x)).ravel()
+    # LAV is robust to the outliers: recovers xt to high accuracy
+    assert np.linalg.norm(xg - xt) / np.linalg.norm(xt) < 1e-5
+
+
+@pytest.mark.slow
+def test_lav_sparse_10k_x_5k(grid24):
+    """The VERDICT 'Done' criterion: sparse LAV at 10k x 5k converges to
+    duality gap < 1e-6 on the 8-device mesh -- a problem size whose
+    dense normal matrix (10k x 10k from a 30k-variable LP) would be
+    outside the dense IPM's practical range here."""
+    rng = np.random.default_rng(4)
+    m, n, nnz = 10_000, 5_000, 50_000
+    rows = np.concatenate([rng.integers(0, m, nnz), np.arange(m) % m])
+    cols = np.concatenate([rng.integers(0, n, nnz), np.arange(m) % n])
+    vals = np.concatenate([rng.normal(size=nnz),
+                           np.sign(rng.normal(size=m)) * 0.5])
+    As = sp.coo_matrix((vals, (rows, cols)), shape=(m, n)).tocsr()
+    xt = rng.normal(size=n)
+    b = As @ xt
+    out = rng.choice(m, m // 50, replace=False)
+    b[out] += rng.normal(size=out.size) * 50
+    A = dist_sparse_from_coo(rows, cols, vals, m, n, grid=grid24,
+                             dtype=np.float64)
+    x, info = el.lav_sparse(A, mv_from_global(b.reshape(-1, 1), grid=grid24),
+                            MehrotraCtrl(tol=1e-6, max_iters=60))
+    assert info["converged"], info
+    assert info["rel_gap"] < 1e-6
+    xg = np.asarray(mv_to_global(x)).ravel()
+    assert np.linalg.norm(xg - xt) / np.linalg.norm(xt) < 1e-4
