@@ -135,9 +135,20 @@ def make_symmetric(A: DistMatrix, uplo: str = "L", conj: bool = False) -> DistMa
     return A.with_local(out)
 
 
-def get_diagonal(A: DistMatrix, offset: int = 0):
-    """Replicated diagonal vector (the reference returns [MD,STAR]; our MD is
-    physically replicated, so this returns a [STAR,STAR] (k,1) DistMatrix)."""
+def get_diagonal(A: DistMatrix, offset: int = 0, dist: str = "star"):
+    """Diagonal of A as a (k, 1) DistMatrix.
+
+    ``dist='star'`` (default): replicated [STAR,STAR] -- the convenient
+    form every elementwise consumer here takes.  ``dist='md'``: TRUE
+    [MD,STAR] output (the reference's return type): diagonal entry k of
+    an [MC,MR] matrix lives on device (k%r, k%c), which IS its MD owner,
+    so the extraction is device-co-located and the per-device allocation
+    is O(k/lcm) -- no replicated k-vector exists."""
+    if dist == "md":
+        return _get_diagonal_md(A, offset)
+    if dist != "star":
+        raise ValueError(f"get_diagonal dist must be 'star' or 'md', "
+                         f"got {dist!r}")
     m, n = A.gshape
     k = min(m, n - offset) if offset >= 0 else min(m + offset, n)
     I, J = _global_indices(A)
@@ -152,6 +163,34 @@ def get_diagonal(A: DistMatrix, offset: int = 0):
     from ..core.dist import STAR as _S
     out = DistMatrix(vec, (k, 1), _S, _S, 0, 0, A.grid)
     return out
+
+
+def _get_diagonal_md(A: DistMatrix, offset: int):
+    """[MD,STAR] diagonal extraction (offset 0; co-located, O(k/lcm))."""
+    from ..core.dist import MC as _MC, MR as _MR, MD as _MD, STAR as _S
+    from ..core.dist import md_slot_of_global, stride as _stride
+    from ..core import indexing as _ix
+    if offset != 0:
+        raise NotImplementedError("MD output supports the main diagonal")
+    if (A.cdist, A.rdist) != (_MC, _MR) or A.calign or A.ralign:
+        raise ValueError("MD extraction needs a zero-aligned [MC,MR] source")
+    m, n = A.gshape
+    k = min(m, n)
+    r, c = A.grid.height, A.grid.width
+    L = _stride(_MD, r, c)
+    l = _ix.max_local_length(k, L)
+    lr, lc = A.local_rows, A.local_cols
+    # storage coordinates of global (kk, kk) and the MD slot it feeds;
+    # both live on device (kk%r, kk%c), so XLA lowers this to local moves
+    kk = jnp.arange(k)
+    ri = (kk % r) * lr + kk // r
+    cj = (kk % c) * lc + kk // c
+    vals = A.local[ri, cj]
+    slots = jnp.asarray(md_slot_of_global(r, c, k))
+    stor = jnp.zeros((r * c * l, 1), A.dtype).at[slots, 0].set(vals)
+    out = DistMatrix(stor, (k, 1), _MD, _S, 0, 0, A.grid)
+    import jax as _jax
+    return out.with_local(_jax.device_put(stor, A.grid.sharding(out.spec)))
 
 
 def _diag_vals(A: DistMatrix, d: DistMatrix, offset: int):

@@ -10,13 +10,19 @@ of shape r x c (p = r*c):
   VC    -- 1-D cyclic over all p devices, column-major rank  q = mc + r*mr
   VR    -- 1-D cyclic over all p devices, row-major rank     q = mr + c*mc
   STAR  -- replicated
-  MD    -- matrix diagonal distribution.  v1 stores MD *physically replicated*
-           (the logical owner math -- entry k on device (k%r, k%c) -- is only
-           used by GetDiagonal/SetDiagonal, which on TPU are cheap masked
-           collectives; a dedicated sparse storage buys nothing on the MXU).
-  CIRC  -- all data on the root.  v1 stores CIRC physically replicated as
-           well (gather-to-all); the tag preserves the reference's IO-path
-           semantics ([CIRC,CIRC] gather underlies Print/Write).
+  MD    -- matrix diagonal distribution: entry k on device (k%r, k%c),
+           stride lcm(r, c).  TRUE distributed storage: the storage leaf
+           has p slot-ranges (VR-nested mc-major sharding) of length
+           ceil(n/lcm); device (i,j) owns entries k ~ CRT(i mod r, j mod c)
+           (no entries -- all-zero slots -- when (i-j) % gcd(r,c) != 0).
+           The slot permutation is pack/unpack index math exactly like
+           the cyclic layouts (SURVEY.md §8.1 item 2); diagonals of
+           [MC,MR] matrices extract PURE-LOCALLY into this layout.
+  CIRC  -- all data on the root: the storage leaf is the full array
+           placed on device 0 only (SingleDeviceSharding) -- the
+           reference's gather-to-root under Print/Write, O(mn) on the
+           root and nothing elsewhere.  CIRC never enters shard_map;
+           the engine converts to/from it at the redistribute() edge.
 
 ``jax.lax.all_gather`` over a tuple of axis names orders the gathered blocks
 with the FIRST name MAJOR, so VC's column-major rank order is produced by
@@ -26,6 +32,7 @@ empirically; tests/core/test_redist.py covers it).
 from __future__ import annotations
 
 import enum
+import math
 
 
 class Dist(enum.Enum):
@@ -58,15 +65,48 @@ LEGAL_PAIRS = (
 
 
 def stride(d: Dist, r: int, c: int) -> int:
-    """Number of ranks the dimension is split over (physical storage)."""
+    """Number of ranks the dimension is split over (index-math stride)."""
     if d is Dist.MC:
         return r
     if d is Dist.MR:
         return c
     if d in (Dist.VC, Dist.VR):
         return r * c
-    # STAR replicated; MD/CIRC physically replicated in v1.
+    if d is Dist.MD:
+        return r * c // math.gcd(r, c)      # lcm(r, c)
+    # STAR replicated; CIRC root-only (handled at the redistribute edge).
     return 1
+
+
+def storage_slots(d: Dist, r: int, c: int) -> int:
+    """Slot count of the stacked-storage dimension.  Equals the stride for
+    every cyclic layout; MD stacks p slot-ranges (mc-major) even though
+    its stride is lcm(r, c), because its owner map (k%r, k%c) is not a
+    nested axis order -- devices outside the diagonal comm hold zeros."""
+    if d is Dist.MD:
+        return r * c
+    return stride(d, r, c)
+
+
+def md_params(r: int, c: int):
+    """(gcd, lcm, inv) with inv = (r/gcd)^{-1} mod (c/gcd): the static CRT
+    data for the MD owner map.  Device (i, j) owns diagonal entries
+    k = k0 + t*lcm with k0 = i + r * (((j - i)//g * inv) % (c//g)),
+    defined only when (i - j) % g == 0."""
+    g = math.gcd(r, c)
+    cg = c // g
+    inv = pow((r // g) % cg, -1, cg) if cg > 1 else 0
+    return g, r * c // g, inv
+
+
+def md_slot_of_global(r: int, c: int, n: int):
+    """Static numpy map: global index k -> flat storage slot
+    (mc-major device id (k%r)*c + (k%c), local offset k // lcm)."""
+    import numpy as np
+    _, L, _ = md_params(r, c)
+    l = -(-n // L) if n else 1
+    k = np.arange(n)
+    return ((k % r) * c + (k % c)) * l + k // L
 
 
 def gather_axes(d: Dist):
@@ -93,11 +133,17 @@ def spec_component(d: Dist):
         return ("mr", "mc")
     if d is Dist.VR:
         return ("mc", "mr")
+    if d is Dist.MD:
+        return ("mc", "mr")   # p slot-ranges, mc-major (see storage_slots)
     return None
 
 
 def rank_of(d: Dist, r: int, c: int):
-    """This device's rank within the distribution (traced; shard_map only)."""
+    """This device's rank within the distribution (traced; shard_map only).
+
+    For MD the "rank" is k0, the first diagonal entry this device owns
+    (< lcm), or the out-of-range sentinel lcm for devices outside the
+    diagonal comm -- callers mask with :func:`md_owner_mask`."""
     import jax
 
     if d is Dist.MC:
@@ -108,4 +154,10 @@ def rank_of(d: Dist, r: int, c: int):
         return jax.lax.axis_index("mc") + r * jax.lax.axis_index("mr")
     if d is Dist.VR:
         return jax.lax.axis_index("mr") + c * jax.lax.axis_index("mc")
+    if d is Dist.MD:
+        g, L, inv = md_params(r, c)
+        i = jax.lax.axis_index("mc")
+        j = jax.lax.axis_index("mr")
+        k0 = (i + r * ((((j - i) // g) * inv) % (c // g))) % L
+        return jax.numpy.where((i - j) % g == 0, k0, L)
     return 0

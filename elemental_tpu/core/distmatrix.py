@@ -30,10 +30,12 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from . import indexing as ix
-from .dist import Dist, STAR, LEGAL_PAIRS, stride as dist_stride, spec_component, rank_of
+from .dist import (Dist, STAR, LEGAL_PAIRS, stride as dist_stride,
+                   storage_slots, spec_component, rank_of, md_slot_of_global)
 from .grid import Grid, default_grid
 
 
@@ -136,6 +138,20 @@ def _storage_index(extent: int, stride: int, align: int):
     return gi.reshape(-1)
 
 
+def _storage_index_dim(extent: int, d: Dist, r: int, c: int, align: int):
+    """Storage-position -> global-index map for one dimension, MD-aware."""
+    if d is Dist.MD:
+        if align:
+            raise ValueError("MD alignments are unsupported")
+        L = dist_stride(d, r, c)
+        l = ix.max_local_length(extent, L)
+        slots = r * c * l
+        inv = np.full(slots, extent, np.int64)        # padding sentinel
+        inv[np.asarray(md_slot_of_global(r, c, extent))] = np.arange(extent)
+        return jnp.asarray(inv)
+    return _storage_index(extent, dist_stride(d, r, c), align)
+
+
 def from_global(arr, cdist: Dist, rdist: Dist, grid: Grid | None = None,
                 calign: int = 0, ralign: int = 0, device_put: bool = True) -> DistMatrix:
     """Build a DistMatrix (stacked-storage form) from a replicated global array."""
@@ -144,10 +160,15 @@ def from_global(arr, cdist: Dist, rdist: Dist, grid: Grid | None = None,
     arr = jnp.asarray(arr)
     m, n = arr.shape
     r, c = grid.height, grid.width
-    sc = dist_stride(cdist, r, c)
-    sr = dist_stride(rdist, r, c)
-    ridx = _storage_index(m, sc, calign)
-    cidx = _storage_index(n, sr, ralign)
+    if cdist is Dist.CIRC:
+        # root-only: the full array on device 0, nothing elsewhere
+        dm = DistMatrix(arr, (m, n), cdist, rdist, 0, 0, grid)
+        if device_put:
+            dm = dm.with_local(jax.device_put(
+                arr, jax.sharding.SingleDeviceSharding(grid.mesh.devices.flat[0])))
+        return dm
+    ridx = _storage_index_dim(m, cdist, r, c, calign)
+    cidx = _storage_index_dim(n, rdist, r, c, ralign)
     stor = jnp.take(arr, ridx, axis=0, mode="fill", fill_value=0)
     stor = jnp.take(stor, cidx, axis=1, mode="fill", fill_value=0)
     dm = DistMatrix(stor, (m, n), cdist, rdist, calign, ralign, grid)
@@ -159,14 +180,20 @@ def from_global(arr, cdist: Dist, rdist: Dist, grid: Grid | None = None,
 def to_global(A: DistMatrix):
     """Recover the mathematical (m, n) array from stacked storage."""
     m, n = A.gshape
+    if A.cdist is Dist.CIRC:
+        return A.local
+    r, c = A.grid.height, A.grid.width
     sc, sr = A.col_stride, A.row_stride
     lr, lc = A.local_rows, A.local_cols
     stor = A.local
-    # inverse permutation: global i lives at storage row owner(i)*lr + i//sc
-    i = jnp.arange(m)
-    ri = ((i + A.calign) % sc) * lr + i // sc
-    j = jnp.arange(n)
-    cj = ((j + A.ralign) % sr) * lc + j // sr
+    if A.cdist is Dist.MD:
+        ri = jnp.asarray(md_slot_of_global(r, c, m))
+    else:
+        ri = ((jnp.arange(m) + A.calign) % sc) * lr + jnp.arange(m) // sc
+    if A.rdist is Dist.MD:
+        cj = jnp.asarray(md_slot_of_global(r, c, n))
+    else:
+        cj = ((jnp.arange(n) + A.ralign) % sr) * lc + jnp.arange(n) // sr
     out = jnp.take(stor, ri, axis=0)
     out = jnp.take(out, cj, axis=1)
     return out
@@ -178,8 +205,14 @@ def zeros(m: int, n: int, cdist: Dist = Dist.MC, rdist: Dist = Dist.MR,
     _check_pair(cdist, rdist)
     grid = grid or default_grid()
     r, c = grid.height, grid.width
+    if cdist is Dist.CIRC:
+        dm = DistMatrix(None, (m, n), cdist, rdist, 0, 0, grid)
+        stor = jnp.zeros((m, n), dtype)
+        return dm.with_local(jax.device_put(
+            stor, jax.sharding.SingleDeviceSharding(grid.mesh.devices.flat[0])))
+    qc, qr_ = storage_slots(cdist, r, c), storage_slots(rdist, r, c)
     sc, sr = dist_stride(cdist, r, c), dist_stride(rdist, r, c)
     lr, lc = ix.max_local_length(m, sc), ix.max_local_length(n, sr)
     dm = DistMatrix(None, (m, n), cdist, rdist, calign, ralign, grid)
-    stor = jnp.zeros((sc * lr, sr * lc), dtype)
+    stor = jnp.zeros((qc * lr, qr_ * lc), dtype)
     return dm.with_local(jax.device_put(stor, grid.sharding(dm.spec)))

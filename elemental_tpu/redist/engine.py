@@ -36,7 +36,7 @@ from jax import lax
 from ..core import indexing as ix
 from ..core.dist import (
     Dist, MC, MR, VC, VR, STAR, MD, CIRC,
-    stride as dist_stride, gather_axes, rank_of,
+    stride as dist_stride, gather_axes, rank_of, md_slot_of_global,
 )
 from ..core.distmatrix import DistMatrix, _check_pair
 
@@ -56,6 +56,16 @@ def _pad_dim(x, dim: int, target: int):
 
 def _gather_dim(x, dim: int, d: Dist, align: int, extent: int, r: int, c: int):
     """Rebuild the full (true-extent) dimension on every device."""
+    if d is MD:
+        # p slot-ranges of length l gathered mc-major, then the static
+        # slot permutation rebuilds global order (copy:: for [MD,*])
+        g = lax.all_gather(x, ("mc", "mr"), axis=0)       # (p, l, ...)
+        shape = list(x.shape)
+        shape[dim] = x.shape[dim] * r * c
+        g = jnp.moveaxis(g, 0, dim)
+        gflat = g.reshape(shape)                          # slot-major flat
+        idx = jnp.asarray(md_slot_of_global(r, c, extent))
+        return jnp.take(gflat, idx, axis=dim)
     S = dist_stride(d, r, c)
     if S == 1:
         return lax.slice_in_dim(x, 0, extent, axis=dim)
@@ -67,6 +77,18 @@ def _gather_dim(x, dim: int, d: Dist, align: int, extent: int, r: int, c: int):
     shape[dim] = x.shape[dim] * S
     g = g.reshape(shape)                                  # index i = iLoc*S + s
     return lax.slice_in_dim(g, 0, extent, axis=dim)
+
+
+def _filter_md(x, dim: int, extent: int, r: int, c: int):
+    """Replicated dim -> this device's MD slot range: k = k0 + t*lcm for
+    owners (k0 = rank_of(MD) < lcm), all-zero slots for devices outside
+    the diagonal comm (sentinel k0 == lcm maps every index out of range)."""
+    L = dist_stride(MD, r, c)
+    l = ix.max_local_length(extent, L)
+    k0 = rank_of(MD, r, c)
+    gi = jnp.arange(l) * L + k0
+    gi = jnp.where((k0 < L) & (gi < extent), gi, extent)
+    return jnp.take(x, gi, axis=dim, mode="fill", fill_value=0)
 
 
 def _filter_dim(x, dim: int, S: int, shift, l_out: int):
@@ -235,8 +257,16 @@ def _from_star_star(xg, gshape, cdist, rdist, calign, ralign, grid) -> DistMatri
     Sc, Sr = dist_stride(cdist, r, c), dist_stride(rdist, r, c)
     lr = ix.max_local_length(gshape[0], Sc)
     lc = ix.max_local_length(gshape[1], Sr)
-    loc = _filter_dim(xg, 0, Sc, ix.shift(rank_of(cdist, r, c), calign, Sc), lr)
-    loc = _filter_dim(loc, 1, Sr, ix.shift(rank_of(rdist, r, c), ralign, Sr), lc)
+    if cdist is MD:
+        loc = _filter_md(xg, 0, gshape[0], r, c)
+    else:
+        loc = _filter_dim(xg, 0, Sc,
+                          ix.shift(rank_of(cdist, r, c), calign, Sc), lr)
+    if rdist is MD:
+        loc = _filter_md(loc, 1, gshape[1], r, c)
+    else:
+        loc = _filter_dim(loc, 1, Sr,
+                          ix.shift(rank_of(rdist, r, c), ralign, Sr), lc)
     # zero the padding tail (rows whose global index >= extent)
     loc = _zero_padding(loc, gshape, cdist, rdist, calign, ralign, grid)
     return DistMatrix(loc, gshape, cdist, rdist, calign, ralign, grid)
@@ -247,6 +277,8 @@ def _zero_padding(loc, gshape, cdist, rdist, calign, ralign, grid) -> jnp.ndarra
     r, c = grid.height, grid.width
     Sc, Sr = dist_stride(cdist, r, c), dist_stride(rdist, r, c)
     out = loc
+    if cdist is MD or rdist is MD:
+        return out        # _filter_md zero-fills everything out of range
     if loc.shape[0] * Sc != gshape[0]:
         shift = ix.shift(rank_of(cdist, r, c), calign, Sc)
         gi = jnp.arange(loc.shape[0]) * Sc + shift
@@ -272,6 +304,16 @@ def to_dist(A: DistMatrix, cdist: Dist, rdist: Dist,
 
     if src == dst and (A.calign, A.ralign) == (calign, ralign):
         return A
+
+    # MD's owner map is not a nested axis order: every conversion rides
+    # the MD-aware gather/filter through [STAR,STAR] (copy::Gather/
+    # Scatter class; the hot MD op -- diagonal extraction -- is the
+    # pure-local path in level1.get_diagonal, not a redistribution)
+    if MD in (A.cdist, A.rdist, cdist, rdist):
+        if (calign, ralign) != (0, 0):
+            raise ValueError("MD redistributions require zero alignments")
+        ss = to_star_star(A)
+        return _from_star_star(ss.local, A.gshape, cdist, rdist, 0, 0, g)
 
     # alignment-only change: a pure per-dim device rotation
     if src == dst:
@@ -533,7 +575,6 @@ def _scatter_sum_dim(x, dim: int, axis_name: str, S: int, l_out: int):
 # public jit-able wrapper
 # ---------------------------------------------------------------------
 
-@partial(jax.jit, static_argnums=(1, 2, 3, 4))
 def redistribute(A: DistMatrix, cdist: Dist, rdist: Dist,
                  calign: int = 0, ralign: int = 0) -> DistMatrix:
     """B[cdist,rdist] = A, as a standalone (jit-able) op on storage-form
@@ -541,8 +582,33 @@ def redistribute(A: DistMatrix, cdist: Dist, rdist: Dist,
 
     jit-cached on (static metadata, dst dists, aligns): eager callers (tests,
     blocked loops run outside an enclosing jit) hit the compile cache instead
-    of re-tracing a fresh ``shard_map`` closure per call."""
+    of re-tracing a fresh ``shard_map`` closure per call.
+
+    CIRC conversions (root-only storage) run EAGERLY at this edge via the
+    global bridges plus cross-device ``device_put`` (copy::Gather /
+    copy::Scatter) -- they cannot live inside jit/shard_map."""
     _check_pair(cdist, rdist)
+    if cdist is CIRC or A.cdist is CIRC:
+        from ..core.distmatrix import from_global, to_global
+        import jax.sharding as jsh
+        g = A.grid
+        if A.cdist is CIRC and cdist is CIRC:
+            return A
+        if cdist is CIRC:
+            arr = to_global(A)               # device computation on storage
+            arr = jax.device_put(
+                arr, jsh.SingleDeviceSharding(g.mesh.devices.flat[0]))
+            return DistMatrix(arr, A.gshape, CIRC, CIRC, 0, 0, g)
+        # CIRC source: broadcast the root array, then scatter normally
+        arr = jax.device_put(A.local, g.sharding(jax.sharding.PartitionSpec()))
+        return from_global(arr, cdist, rdist, grid=g,
+                           calign=calign, ralign=ralign)
+    return _redistribute_jit(A, cdist, rdist, calign, ralign)
+
+
+@partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def _redistribute_jit(A: DistMatrix, cdist: Dist, rdist: Dist,
+                      calign: int, ralign: int) -> DistMatrix:
     out_meta = DistMatrix(None, A.gshape, cdist, rdist, calign, ralign, A.grid)
 
     def f(a):
