@@ -85,7 +85,22 @@ def gemm(A: DistMatrix, B: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None
     ``alg``: 'auto' keeps the largest operand stationary (the reference's
     heuristic in ``Gemm.cpp``), or one of 'A' / 'B' / 'C' / 'gspmd'
     ('gspmd' = single storage matmul, XLA chooses the schedule).
+
+    Tiled ``BlockMatrix`` operands are accepted via read-proxy conversion
+    (``DistMatrixReadProxy``): they re-lay out to [MC,MR] on entry; the
+    result converts back to tiled when every input was tiled.
     """
+    from ..core.block import BlockMatrix, as_elemental, block_from_cyclic
+    tiled_in = [isinstance(x, BlockMatrix) for x in (A, B, C)
+                if x is not None]
+    ret_tiled = bool(tiled_in) and all(tiled_in)
+    A, B = as_elemental(A), as_elemental(B)
+    if C is not None:
+        C = as_elemental(C)
+    if ret_tiled:
+        out = gemm(A, B, alpha, beta, C, orient_a, orient_b, alg, nb,
+                   precision)
+        return block_from_cyclic(out)
     A = _orient(A, orient_a)
     B = _orient(B, orient_b)
     _check_mcmr(A, B)

@@ -1,0 +1,148 @@
+"""BlockMatrix: the tiled (XLA-native) second layout.
+
+Reference: ``DistMatrix<T,U,V,BLOCK>`` / ``BlockMatrix<T>``
+(``include/El/core/DistMatrix/Block/**``): upstream's second wrap, a
+block(-cyclic) layout kept mainly for ScaLAPACK interop.  On TPU the
+roles invert (SURVEY.md §3.8): CONTIGUOUS TILES are the native XLA
+sharding -- ``P('mc','mr')`` on the padded global array -- so BlockMatrix
+is the zero-cost interop wrap for ordinary XLA-sharded arrays, while the
+elemental (cyclic) ``DistMatrix`` remains the load-balanced layout of
+the blocked factorizations.
+
+The storage leaf IS the global array (padded to uniform tiles), so
+``block_from_global``/``block_to_global`` are just device_put/slice; the
+cyclic<->tiled conversions are the per-dim index permutations between the
+two storage orders (tiled row i <-> cyclic slot (i%r)*lr + i//r), which
+GSPMD lowers to the minimal all_to_all -- exactly the re-layout cost the
+reference pays between elemental and BLOCK operands.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from . import indexing as ix
+from .dist import MC, MR
+from .distmatrix import DistMatrix
+from .grid import Grid, default_grid
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["local"],
+    meta_fields=["gshape", "grid"],
+)
+@dataclasses.dataclass(frozen=True)
+class BlockMatrix:
+    """Tiled 2-D layout: device (i, j) owns the contiguous tile
+    rows [i*tr, (i+1)*tr) x cols [j*tc, (j+1)*tc) of the padded global
+    array (tr = ceil(m/r), tc = ceil(n/c))."""
+    local: Any                    # (r*tr, c*tc) padded global, P('mc','mr')
+    gshape: tuple
+    grid: Grid
+
+    @property
+    def tile_rows(self) -> int:
+        return ix.max_local_length(self.gshape[0], self.grid.height)
+
+    @property
+    def tile_cols(self) -> int:
+        return ix.max_local_length(self.gshape[1], self.grid.width)
+
+    @property
+    def spec(self) -> P:
+        return P("mc", "mr")
+
+    @property
+    def dtype(self):
+        return self.local.dtype
+
+    def with_local(self, local) -> "BlockMatrix":
+        return dataclasses.replace(self, local=local)
+
+    def __repr__(self):
+        return (f"BlockMatrix(gshape={self.gshape}, grid={self.grid}, "
+                f"dtype={self.local.dtype})")
+
+
+def block_from_global(arr, grid: Grid | None = None,
+                      device_put: bool = True) -> BlockMatrix:
+    """Wrap a global array in the tiled layout (pad + device_put)."""
+    grid = grid or default_grid()
+    arr = jnp.asarray(arr)
+    m, n = arr.shape
+    r, c = grid.height, grid.width
+    tr, tc = ix.max_local_length(m, r), ix.max_local_length(n, c)
+    pad = jnp.zeros((r * tr, c * tc), arr.dtype).at[:m, :n].set(arr)
+    B = BlockMatrix(pad, (m, n), grid)
+    if device_put:
+        B = B.with_local(jax.device_put(pad, grid.sharding(B.spec)))
+    return B
+
+
+def block_from_array(arr, grid: Grid | None = None) -> BlockMatrix:
+    """Adopt an ALREADY-SHARDED XLA array whose sharding matches the
+    tiled layout (zero-copy interop edge); shapes must be pre-padded."""
+    grid = grid or default_grid()
+    m, n = arr.shape
+    return BlockMatrix(arr, (m, n), grid)
+
+
+def block_to_global(B: BlockMatrix):
+    """Recover the (m, n) array (slice off tile padding)."""
+    return B.local[: B.gshape[0], : B.gshape[1]]
+
+
+@partial(jax.jit, static_argnums=())
+def block_to_cyclic(B: BlockMatrix) -> DistMatrix:
+    """BlockMatrix -> elemental [MC,MR] DistMatrix (one all_to_all-class
+    re-layout per dim, inserted by GSPMD from the index permutation)."""
+    m, n = B.gshape
+    g = B.grid
+    r, c = g.height, g.width
+    lr, lc = ix.max_local_length(m, r), ix.max_local_length(n, c)
+    # cyclic storage slot q*l + t holds global index t*S + q
+    ri = (jnp.arange(r * lr) % lr) * r + jnp.arange(r * lr) // lr
+    cj = (jnp.arange(c * lc) % lc) * c + jnp.arange(c * lc) // lc
+    stor = jnp.take(B.local, jnp.minimum(ri, B.local.shape[0] - 1), axis=0)
+    stor = jnp.take(stor, jnp.minimum(cj, B.local.shape[1] - 1), axis=1)
+    stor = jnp.where((ri < m)[:, None] & (cj < n)[None, :], stor, 0)
+    out = DistMatrix(stor, (m, n), MC, MR, 0, 0, g)
+    return out.with_local(jax.lax.with_sharding_constraint(
+        stor, g.sharding(out.spec)))
+
+
+@partial(jax.jit, static_argnums=())
+def block_from_cyclic(A: DistMatrix) -> BlockMatrix:
+    """Elemental [MC,MR] DistMatrix -> BlockMatrix (inverse re-layout)."""
+    if (A.cdist, A.rdist) != (MC, MR) or A.calign or A.ralign:
+        raise ValueError("block_from_cyclic needs a zero-aligned [MC,MR]")
+    m, n = A.gshape
+    g = A.grid
+    r, c = g.height, g.width
+    lr, lc = A.local_rows, A.local_cols
+    tr, tc = ix.max_local_length(m, r), ix.max_local_length(n, c)
+    # tiled row i holds global i; its cyclic slot is (i%r)*lr + i//r
+    i = jnp.arange(r * tr)
+    j = jnp.arange(c * tc)
+    ri = (i % r) * lr + i // r
+    cj = (j % c) * lc + j // c
+    pad = jnp.take(A.local, jnp.minimum(ri, A.local.shape[0] - 1), axis=0)
+    pad = jnp.take(pad, jnp.minimum(cj, A.local.shape[1] - 1), axis=1)
+    pad = jnp.where((i < m)[:, None] & (j < n)[None, :], pad, 0)
+    out = BlockMatrix(pad, (m, n), g)
+    return out.with_local(jax.lax.with_sharding_constraint(
+        pad, g.sharding(out.spec)))
+
+
+def as_elemental(x) -> DistMatrix:
+    """Read-proxy coercion (``DistMatrixReadProxy``): BlockMatrix operands
+    convert to the elemental layout; DistMatrix passes through."""
+    if isinstance(x, BlockMatrix):
+        return block_to_cyclic(x)
+    return x
