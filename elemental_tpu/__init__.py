@@ -27,7 +27,7 @@ __version__ = "0.2.0"
 from . import blas, lapack, matrices, optimization, control
 from .blas import (gemm, herk, syrk, trrk, trsm, trr2k, her2k, syr2k,
                    hemm, symm, trmm, two_sided_trsm, two_sided_trmm,
-                   multishift_trsm)
+                   multishift_trsm, quasi_trsm)
 from .blas import gemv, ger, hemv, symv, her2, trmv, trsv
 from .blas import (axpy, scale, fill, entrywise_map, hadamard,
                    index_dependent_fill, make_trapezoidal, shift_diagonal,
@@ -37,10 +37,12 @@ from .blas import (axpy, scale, fill, entrywise_map, hadamard,
                    adjoint, real_part, imag_part, max_abs_loc, max_loc,
                    scale_trapezoid, axpy_trapezoid, safe_scale,
                    get_submatrix, set_submatrix)
-from .lapack import cholesky, hpd_solve, cholesky_solve_after
-from .lapack import lu, lu_solve, lu_solve_after, permute_rows, permute_cols
+from .lapack import (cholesky, hpd_solve, cholesky_solve_after,
+                     cholesky_pivoted)
+from .lapack import (lu, lu_solve, lu_solve_after, permute_rows,
+                     permute_cols, lu_full_pivot)
 from .lapack import (qr, apply_q, explicit_q, least_squares, tsqr, lq,
-                     apply_q_lq, explicit_l, qr_col_piv)
+                     apply_q_lq, explicit_l, qr_col_piv, rq)
 from .lapack import ridge, tikhonov, lse, glm
 from .lapack import (hermitian_tridiag, apply_q_herm_tridiag, hessenberg,
                      apply_q_hessenberg, bidiag, apply_p_bidiag)

@@ -14,4 +14,4 @@ from .level1 import (axpy, scale, zero, fill, entrywise_map, hadamard,
 from .level2 import gemv, ger, hemv, symv, her2, trmv, trsv
 from .level3 import (gemm, herk, syrk, trrk, trsm, trr2k, her2k, syr2k,
                      hemm, symm, trmm, two_sided_trsm, two_sided_trmm,
-                     multishift_trsm)
+                     multishift_trsm, quasi_trsm)

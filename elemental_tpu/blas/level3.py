@@ -120,14 +120,23 @@ def gemm(A: DistMatrix, B: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None
             raise ValueError(f"C shape {C.gshape} != ({m},{n})")
 
     if alg == "auto":
-        sizes = {"A": m * k, "B": k * n, "C": m * n}
-        alg = max(sizes, key=sizes.get)
+        p = A.grid.size
+        # comm-volume comparison: Dot moves m*n*p (the replicated-C psum),
+        # the stationary schedules move ~k*(m+n) panel gathers -- Dot wins
+        # for small C with a long inner dimension (gemm::SUMMA_NNDot)
+        if m * n * p <= k * (m + n) and p > 1:
+            alg = "dot"
+        else:
+            sizes = {"A": m * k, "B": k * n, "C": m * n}
+            alg = max(sizes, key=sizes.get)
     if alg == "C":
         return _summa_c(alpha, A, B, beta, C, nb, precision)
     if alg == "A":
         return _summa_a(alpha, A, B, beta, C, nb, precision)
     if alg == "B":
         return _summa_b(alpha, A, B, beta, C, nb, precision)
+    if alg == "dot":
+        return _summa_dot(alpha, A, B, beta, C, precision)
     if alg == "gspmd":
         # one-shot: re-land B's k-rows on A's k-col cyclic order ([MR,STAR]),
         # then a single storage matmul -- GSPMD inserts the psum over mr.
@@ -197,6 +206,23 @@ def _summa_b(alpha, A, B, beta, C, nb, precision):
         out = update_view(out, cur.with_local(cur.local + _safe_astype(alpha * panel.local, C.dtype)),
                           rows=(s, e))
     return out
+
+
+def _summa_dot(alpha, A, B, beta, C, precision):
+    """SUMMA-Dot (``gemm::SUMMA_NNDot``, the small-C case): shard the
+    inner dimension 1-D cyclic on BOTH operands ([STAR,VC] x [VC,STAR] --
+    the same cyclic permutation on each side, so the storage matmul
+    contracts correctly), local (m, k/p) x (k/p, n) products, one psum
+    over all devices into the replicated C, filter onto [MC,MR]."""
+    m, n = C.gshape
+    Avc = redistribute(A, STAR, VC)
+    Bvc = redistribute(B, VC, STAR)
+    d = jnp.matmul(Avc.local, Bvc.local, precision=precision)
+    D = DistMatrix(d, (m, n), STAR, STAR, 0, 0, A.grid)
+    out = redistribute(D, MC, MR)
+    return C.with_local(_safe_astype(
+        alpha * out.local + (beta * C.local if _nonzero(beta) else 0),
+        C.dtype))
 
 
 def _nonzero(x) -> bool:
@@ -337,6 +363,86 @@ def _trsm_left(uplo: str, trans: bool, conj: bool, A: DistMatrix, B: DistMatrix,
             # T21 = op(A)[hi-part, s:e] = op(A[s:e, hi-part])
             A1p = redistribute(view(A, rows=(s, e), cols=(lo, hi)), STAR, MC)
             a_loc = A1p.local.T            # [MC,STAR]-storage of A1p^T
+        else:
+            A1p = redistribute(view(A, rows=(lo, hi), cols=(s, e)), MC, STAR)
+            a_loc = A1p.local
+        if conj:
+            a_loc = jnp.conj(a_loc)
+        upd = jnp.matmul(a_loc, X1_mr.local, precision=precision)
+        rest = view(X, rows=(lo, hi))
+        X = update_view(X, rest.with_local(rest.local - upd.astype(X.dtype)),
+                        rows=(lo, hi))
+    return X
+
+
+def quasi_trsm(side: str, orient: str, A: DistMatrix, B: DistMatrix,
+               alpha=1.0, nb: int | None = None, precision=None
+               ) -> DistMatrix:
+    """Solve op(T) X = alpha B (side 'L') or X op(T) = alpha B (side 'R')
+    with T UPPER QUASI-TRIANGULAR (real Schur form: 1x1/2x2 diagonal
+    blocks, i.e. an upper triangle plus isolated subdiagonal entries).
+    Reference: ``El::QuasiTrsm`` (``src/blas_like/level3/QuasiTrsm/``).
+
+    TPU shape: ONE host read of T's subdiagonal places the panel splits
+    so no 2x2 block is cut; each replicated diagonal block then solves
+    with a small general ``jnp.linalg.solve`` (quasi-triangular blocks
+    are not XLA-triangular-solvable), and the off-panel updates are the
+    standard trsm SUMMA products -- the strictly-lower region outside the
+    bumps is zero, so the update blocks are genuinely triangular."""
+    trans = orient in ("T", "C")
+    conj = orient == "C"
+    if side.upper().startswith("R"):
+        BT = redistribute(transpose_dist(B), MC, MR)
+        XT = _quasi_trsm_left(not trans, conj, A, BT, alpha, nb, precision)
+        return redistribute(transpose_dist(XT), MC, MR)
+    return _quasi_trsm_left(trans, conj, A, B, alpha, nb, precision)
+
+
+def _quasi_trsm_left(trans: bool, conj: bool, A: DistMatrix, B: DistMatrix,
+                     alpha, nb: int | None, precision) -> DistMatrix:
+    from ..blas.level1 import get_diagonal
+    _check_mcmr(A, B)
+    m, n = B.gshape
+    if A.gshape != (m, m):
+        raise ValueError(f"A {A.gshape} incompatible with B {B.gshape}")
+    r, c = A.grid.height, A.grid.width
+    grain = math.lcm(r, c)
+    ib = _blocksize(nb, grain, m)
+    # bump map (one O(m) host sync): a split at e is legal iff sub[e-1]==0.
+    # Splits must stay on the distribution grain (view offsets are
+    # stride-multiples), so an illegal split extends by a WHOLE grain.
+    import numpy as _np
+    sub = _np.asarray(get_diagonal(A, offset=-1).local).ravel() if m > 1 \
+        else _np.zeros(0)
+    starts = []
+    s = 0
+    while s < m:
+        e = min(s + ib, m)
+        while e < m and sub[e - 1] != 0:
+            e = min(e + grain, m)         # never cut a 2x2 block
+        starts.append((s, e))
+        s = e
+    X = B.with_local(alpha * B.local if _nonzero(alpha - 1) else B.local)
+    forward = trans                       # effective-upper sweep direction
+    if not forward:
+        starts = starts[::-1]
+    for s, e in starts:
+        A11 = redistribute(view(A, rows=(s, e), cols=(s, e)), STAR, STAR)
+        a11 = jnp.triu(A11.local, -1)     # upper triangle + the bumps
+        B1 = redistribute(view(X, rows=(s, e)), STAR, VR)
+        op = a11.T if trans else a11
+        if conj:
+            op = jnp.conj(op)
+        x1 = jnp.linalg.solve(op, B1.local)
+        X1 = DistMatrix(x1.astype(X.dtype), B1.gshape, STAR, VR, 0, 0, A.grid)
+        X1_mr = redistribute(X1, STAR, MR)
+        X = update_view(X, redistribute(X1_mr, MC, MR), rows=(s, e))
+        lo, hi = (e, m) if forward else (0, s)
+        if lo >= hi:
+            continue
+        if trans:
+            A1p = redistribute(view(A, rows=(s, e), cols=(lo, hi)), STAR, MC)
+            a_loc = A1p.local.T
         else:
             A1p = redistribute(view(A, rows=(lo, hi), cols=(s, e)), MC, STAR)
             a_loc = A1p.local

@@ -199,3 +199,65 @@ def cholesky_solve_after(L: DistMatrix, B: DistMatrix, uplo: str = "L",
         return trsm("L", "U", "N", L, Y, nb=nb, precision=precision)
     Y = trsm("L", "L", "N", L, B, nb=nb, precision=precision)
     return trsm("L", "L", "C", L, Y, nb=nb, precision=precision)
+
+
+def cholesky_pivoted(A: DistMatrix, tol: float = 0.0, precision=None):
+    """Full (diagonal) pivoted Cholesky of a PSD matrix:
+    ``P A P^T = L L^H`` with the pivot chosen as the largest remaining
+    diagonal each step (LAPACK ``pstrf`` / ``cholesky::PivotedLVar3``,
+    Elemental ``src/lapack_like/factor/Cholesky/PivotedLVar3.hpp``).
+
+    Returns ``(L, perm, rank)``: L lower-triangular [MC,MR], ``perm`` the
+    traced permutation (``(P A P^T)[i, j] = A[perm[i], perm[j]]``), and
+    the detected numerical rank (columns whose pivot fell below
+    ``tol * max_diag`` are zeroed).
+
+    The factorization runs REPLICATED on the gathered matrix (one jitted
+    fori_loop; the reference's pivoted variant is likewise its slow
+    path -- per-column pivot search serializes everything) and scatters
+    the factor back; use the unpivoted :func:`cholesky` for speed on
+    definite matrices.
+    """
+    _check_mcmr(A)
+    n = A.gshape[0]
+    if A.gshape != (n, n):
+        raise ValueError(f"cholesky_pivoted needs square, got {A.gshape}")
+    g = A.grid
+    Ag = redistribute(A, STAR, STAR).local
+    a = jnp.tril(Ag)
+    a = a + jnp.conj(jnp.tril(a, -1)).T
+    rdt = jnp.real(a).dtype
+    # rank threshold anchored on A's ORIGINAL diagonal scale (pstrf
+    # semantics); the working diagonal mixes in L's sqrt-scaled entries
+    thresh = jnp.asarray(tol, rdt) * jnp.maximum(
+        jnp.max(jnp.real(jnp.diagonal(a))), jnp.asarray(1e-30, rdt))
+
+    def body(j, state):
+        a, perm, rank = state
+        d = jnp.real(jnp.diagonal(a))
+        idx = jnp.arange(n)
+        cand = jnp.where(idx >= j, d, -jnp.inf)
+        p = jnp.argmax(cand)
+        # symmetric swap rows/cols j <-> p
+        rj, rp = a[j], a[p]
+        a = a.at[j].set(rp).at[p].set(rj)
+        cj, cp = a[:, j], a[:, p]
+        a = a.at[:, j].set(cp).at[:, p].set(cj)
+        perm = perm.at[j].set(perm[p]).at[p].set(perm[j])
+        piv = jnp.real(a[j, j])
+        ok = piv > thresh
+        sq = jnp.sqrt(jnp.where(ok, piv, 1.0))
+        col = jnp.where(idx > j, a[:, j] / sq, 0).at[j].set(sq)
+        col = jnp.where(ok, col, 0)
+        # trailing update: a[j+1:, j+1:] -= col col^H (lower part suffices)
+        mask = (idx[:, None] > j) & (idx[None, :] > j)
+        a = jnp.where(mask, a - jnp.outer(col, jnp.conj(col)), a)
+        a = a.at[:, j].set(col)
+        rank = rank + jnp.where(ok, 1, 0)
+        return a, perm, rank
+
+    a, perm, rank = lax.fori_loop(0, n, body, (a, jnp.arange(n), 0))
+    L = jnp.tril(a)
+    Ld = redistribute(DistMatrix(L.astype(A.dtype), (n, n), STAR, STAR,
+                                 0, 0, g), MC, MR)
+    return Ld, perm, rank

@@ -341,3 +341,52 @@ def lu_solve_after(LU_: DistMatrix, perm, B: DistMatrix, nb: int | None = None,
     Bp = permute_rows(B, perm)
     Y = trsm("L", "L", "N", LU_, Bp, unit=True, nb=nb, precision=precision)
     return trsm("L", "U", "N", LU_, Y, nb=nb, precision=precision)
+
+
+def lu_full_pivot(A: DistMatrix, precision=None):
+    """LU with COMPLETE pivoting: ``P A Q = L U`` with the pivot the
+    largest remaining |entry| each step (``lu::Full``, Elemental
+    ``src/lapack_like/factor/LU/Full.hpp``).
+
+    Returns ``(LU, rperm, cperm)`` with the getrf-style packed factor and
+    row/column permutations: ``(P A Q)[i, j] = A[rperm[i], cperm[j]]``.
+
+    Runs REPLICATED on the gathered matrix (one jitted fori_loop: the
+    per-step global argmax serializes everything -- the reference's
+    complete-pivot variant is likewise its slow, maximum-stability path;
+    use :func:`lu` (partial pivoting) for speed)."""
+    _check_mcmr(A)
+    m, n = A.gshape
+    kend = min(m, n)
+    g = A.grid
+    a = redistribute(A, STAR, STAR).local
+    ridx = jnp.arange(m)
+    cidx = jnp.arange(n)
+
+    def body(j, state):
+        a, rp, cp = state
+        absa = jnp.abs(a)
+        mask = (ridx[:, None] >= j) & (cidx[None, :] >= j)
+        cand = jnp.where(mask, absa, -jnp.inf)
+        flat = jnp.argmax(cand)
+        pi, pj = flat // n, flat % n
+        # row swap j <-> pi
+        rj, rpv = a[j], a[pi]
+        a = a.at[j].set(rpv).at[pi].set(rj)
+        rp = rp.at[j].set(rp[pi]).at[pi].set(rp[j])
+        # col swap j <-> pj
+        cj, cpv = a[:, j], a[:, pj]
+        a = a.at[:, j].set(cpv).at[:, pj].set(cj)
+        cp = cp.at[j].set(cp[pj]).at[pj].set(cp[j])
+        piv = a[j, j]
+        safe = jnp.where(piv == 0, 1, piv)
+        l = jnp.where(ridx > j, a[:, j] / safe, jnp.zeros_like(a[:, j]))
+        a = a.at[:, j].set(jnp.where(ridx > j, l, a[:, j]))
+        urow = jnp.where(cidx > j, a[j], jnp.zeros_like(a[j]))
+        a = a - jnp.outer(l, urow)
+        return a, rp, cp
+
+    a, rp, cp = lax.fori_loop(0, kend, body,
+                              (a, jnp.arange(m), jnp.arange(n)))
+    LU_ = redistribute(DistMatrix(a, (m, n), STAR, STAR, 0, 0, g), MC, MR)
+    return LU_, rp, cp

@@ -395,6 +395,36 @@ def explicit_l(Ap: DistMatrix) -> DistMatrix:
     return redistribute(transpose_dist(R, conj=True), MC, MR)
 
 
+def rq(A: DistMatrix, nb: int | None = None, precision=None):
+    """RQ factorization ``A = R Q`` (``El::RQ``) with R (m, k) upper
+    triangular/trapezoidal against the BOTTOM-RIGHT corner and Q (k, n)
+    having orthonormal rows (k = min(m, n)).
+
+    Computed via the exchange identity: with J the anti-identity,
+    J_m A J_n = L W (LQ)  =>  A = (J_m L J_k) (J_k W J_n), and the flip of
+    a lower-trapezoidal L is upper-trapezoidal.  Returns explicit (R, Q)
+    (the reference's packed-reflector form is reachable through
+    :func:`lq` on the flipped matrix)."""
+    from .lu import permute_rows, permute_cols
+    m, n = A.gshape
+    k = min(m, n)
+    rev_m = jnp.arange(m)[::-1]
+    rev_n = jnp.arange(n)[::-1]
+    rev_k = jnp.arange(k)[::-1]
+    Af = permute_cols(permute_rows(A, rev_m), rev_n)     # J_m A J_n
+    packed, tau = lq(Af, nb=nb, precision=_hi(precision))
+    L = explicit_l(packed)                               # (m, k)
+    from ..matrices.basic import identity
+    I = identity(n, grid=A.grid, dtype=A.dtype)
+    Wfull = apply_q_lq(packed, tau, I, orient="N", nb=nb,
+                       precision=_hi(precision))         # rows of the unitary
+    from ..redist.interior import interior_view
+    W = interior_view(Wfull, (0, k), (0, n)) if k < n else Wfull
+    R = permute_cols(permute_rows(L, rev_m), rev_k)
+    Q = permute_cols(permute_rows(W, rev_k), rev_n)
+    return R, Q
+
+
 # ---------------------------------------------------------------------
 # TSQR (tall-skinny)
 # ---------------------------------------------------------------------
