@@ -123,8 +123,10 @@ def gemm(A: DistMatrix, B: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None
         p = A.grid.size
         # comm-volume comparison: Dot moves m*n*p (the replicated-C psum),
         # the stationary schedules move ~k*(m+n) panel gathers -- Dot wins
-        # for small C with a long inner dimension (gemm::SUMMA_NNDot)
-        if m * n * p <= k * (m + n) and p > 1:
+        # for small C with a long inner dimension (gemm::SUMMA_NNDot).
+        # STRICT inequality (square matmuls on p=2 hit equality) plus an
+        # absolute cap: Dot replicates C on every device.
+        if m * n * p < k * (m + n) and p > 1 and m * n <= (1 << 22):
             alg = "dot"
         else:
             sizes = {"A": m * k, "B": k * n, "C": m * n}
@@ -411,9 +413,8 @@ def _quasi_trsm_left(trans: bool, conj: bool, A: DistMatrix, B: DistMatrix,
     # bump map (one O(m) host sync): a split at e is legal iff sub[e-1]==0.
     # Splits must stay on the distribution grain (view offsets are
     # stride-multiples), so an illegal split extends by a WHOLE grain.
-    import numpy as _np
-    sub = _np.asarray(get_diagonal(A, offset=-1).local).ravel() if m > 1 \
-        else _np.zeros(0)
+    sub = np.asarray(get_diagonal(A, offset=-1).local).ravel() if m > 1 \
+        else np.zeros(0)
     starts = []
     s = 0
     while s < m:

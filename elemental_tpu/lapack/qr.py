@@ -414,12 +414,18 @@ def rq(A: DistMatrix, nb: int | None = None, precision=None):
     Af = permute_cols(permute_rows(A, rev_m), rev_n)     # J_m A J_n
     packed, tau = lq(Af, nb=nb, precision=_hi(precision))
     L = explicit_l(packed)                               # (m, k)
+    # W = first k rows of the (n, n) LQ unitary.  Rows cannot be sliced
+    # before a left-apply, but W^H = Q^H [I_k; 0]: apply Q^H to the
+    # (n, k) identity SLAB and adjoint -- O(n k) instead of O(n^2).
     from ..matrices.basic import identity
-    I = identity(n, grid=A.grid, dtype=A.dtype)
-    Wfull = apply_q_lq(packed, tau, I, orient="N", nb=nb,
-                       precision=_hi(precision))         # rows of the unitary
     from ..redist.interior import interior_view
-    W = interior_view(Wfull, (0, k), (0, n)) if k < n else Wfull
+    from ..redist.engine import transpose_dist
+    Ik = interior_view(identity(n, grid=A.grid, dtype=A.dtype), (0, n),
+                       (0, k)) if k < n \
+        else identity(n, grid=A.grid, dtype=A.dtype)
+    Wh = apply_q_lq(packed, tau, Ik, orient="C", nb=nb,
+                    precision=_hi(precision))            # (n, k) = W^H
+    W = redistribute(transpose_dist(Wh, conj=True), MC, MR)
     R = permute_cols(permute_rows(L, rev_m), rev_k)
     Q = permute_cols(permute_rows(W, rev_k), rev_n)
     return R, Q

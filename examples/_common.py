@@ -1,0 +1,30 @@
+"""Shared example scaffolding: device/grid setup + reporting.
+
+The analog of the boilerplate every upstream driver repeats
+(``El::Initialize`` + ``El::Input`` + grid construction; Elemental
+``examples/**``).  Examples run on whatever devices are visible -- the
+one real TPU chip, or a virtual CPU mesh via
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/cholesky.py --n 512
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def setup(argv=None):
+    import jax
+    import elemental_tpu as el
+    args = el.Args(sys.argv[1:] if argv is None else argv)
+    height = args.input("--grid-height", "grid height (0 = near-square)", 0)
+    devs = jax.devices()
+    grid = el.Grid(devs, height=height or None)
+    return el, args, grid
+
+
+def report(name, **metrics):
+    parts = " ".join(f"{k}={v:.3e}" if isinstance(v, float) else f"{k}={v}"
+                     for k, v in metrics.items())
+    print(f"[{name}] {parts}")

@@ -1,0 +1,21 @@
+"""HPD factor + solve driver (upstream ``examples/lapack_like/Cholesky.cpp``)."""
+import numpy as np
+from _common import setup, report
+
+el, args, grid = setup()
+n = args.input("--n", "matrix size", 300)
+nrhs = args.input("--nrhs", "right-hand sides", 4)
+args.process(report=True)
+
+rng = np.random.default_rng(0)
+F = el.to_global(el.matrices.hermitian_uniform_spectrum(n, 1.0, 10.0, grid=grid))
+F = np.asarray(F, np.float64)
+A = el.from_global(F, el.MC, el.MR, grid=grid)
+L = el.cholesky(A)
+Lg = np.asarray(el.to_global(L))
+resid = np.linalg.norm(F - Lg @ Lg.T) / np.linalg.norm(F)
+B = el.from_global(rng.normal(size=(n, nrhs)), el.MC, el.MR, grid=grid)
+X = el.hpd_solve(A, B)
+sres = np.linalg.norm(F @ np.asarray(el.to_global(X))
+                      - np.asarray(el.to_global(B))) / np.linalg.norm(F)
+report("cholesky", n=n, factor_resid=resid, solve_resid=sres)

@@ -1,0 +1,19 @@
+"""Hermitian eigensolver (upstream ``examples/lapack_like/HermitianEig.cpp``)."""
+import numpy as np
+from _common import setup, report
+
+el, args, grid = setup()
+n = args.input("--n", "matrix size", 200)
+args.process(report=True)
+
+rng = np.random.default_rng(0)
+G = rng.normal(size=(n, n))
+F = (G + G.T) / 2
+A = el.from_global(F, el.MC, el.MR, grid=grid)
+w, Z = el.herm_eig(A)
+Zg = np.asarray(el.to_global(Z))
+w = np.asarray(w)
+resid = np.linalg.norm(F @ Zg - Zg * w[None, :]) / np.linalg.norm(F)
+orth = np.linalg.norm(Zg.T @ Zg - np.eye(n))
+report("herm_eig", n=n, resid=resid, orth=orth,
+       w_min=float(w[0]), w_max=float(w[-1]))

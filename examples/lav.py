@@ -1,0 +1,29 @@
+"""Sparse least-absolute-value regression (upstream ``examples/optimization/LAV.cpp``)."""
+import numpy as np
+from _common import setup, report
+
+el, args, grid = setup()
+m = args.input("--m", "rows", 400)
+n = args.input("--n", "cols", 60)
+nnz = args.input("--nnz", "nonzeros", 3000)
+args.process(report=True)
+
+from elemental_tpu.sparse.core import dist_sparse_from_coo
+from elemental_tpu.core.multivec import mv_from_global, mv_to_global
+rng = np.random.default_rng(0)
+rows = rng.integers(0, m, nnz)
+cols = rng.integers(0, n, nnz)
+vals = rng.normal(size=nnz)
+import scipy.sparse as sp
+As = sp.coo_matrix((vals, (rows, cols)), shape=(m, n)).tocsr()
+xt = rng.normal(size=n)
+b = As @ xt
+out = rng.choice(m, m // 10, replace=False)
+b[out] += rng.normal(size=out.size) * 20            # gross outliers
+A = dist_sparse_from_coo(rows, cols, vals, m, n, grid=grid, dtype=np.float64)
+x, info = el.lav_sparse(A, mv_from_global(b.reshape(-1, 1), grid=grid),
+                        el.MehrotraCtrl(tol=1e-6, max_iters=60))
+xg = np.asarray(mv_to_global(x)).ravel()
+report("lav", m=m, n=n, converged=info["converged"],
+       rel_gap=info["rel_gap"],
+       recovery_err=float(np.linalg.norm(xg - xt) / np.linalg.norm(xt)))

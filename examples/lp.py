@@ -1,0 +1,19 @@
+"""Dense Mehrotra LP (upstream ``examples/optimization/LP.cpp``-style)."""
+import numpy as np
+from _common import setup, report
+
+el, args, grid = setup()
+m = args.input("--m", "constraints", 20)
+n = args.input("--n", "variables", 50)
+args.process(report=True)
+
+rng = np.random.default_rng(0)
+A = rng.normal(size=(m, n))
+x0 = rng.uniform(0.5, 1.5, n)
+b = A @ x0
+c = A.T @ rng.normal(size=m) + rng.uniform(0.1, 2.0, n)
+g = lambda F: el.from_global(np.atleast_2d(F.T).T if F.ndim == 1 else F,
+                             el.MC, el.MR, grid=grid)
+x, y, z, info = el.lp(g(A), g(b.reshape(-1, 1)), g(c.reshape(-1, 1)))
+report("lp", m=m, n=n, converged=info["converged"],
+       rel_gap=info["rel_gap"], iters=info["iters"])

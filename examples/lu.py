@@ -1,0 +1,19 @@
+"""LU with partial pivoting (upstream ``examples/lapack_like/LU.cpp``)."""
+import numpy as np
+from _common import setup, report
+
+el, args, grid = setup()
+n = args.input("--n", "matrix size", 300)
+args.process(report=True)
+
+rng = np.random.default_rng(0)
+F = rng.normal(size=(n, n))
+A = el.from_global(F, el.MC, el.MR, grid=grid)
+LU, perm = el.lu(A)
+lug = np.asarray(el.to_global(LU))
+L = np.tril(lug, -1) + np.eye(n)
+U = np.triu(lug)
+resid = np.linalg.norm(L @ U - F[np.asarray(perm)]) / np.linalg.norm(F)
+X = el.lu_solve(A, el.from_global(np.ones((n, 2)), el.MC, el.MR, grid=grid))
+sres = np.linalg.norm(F @ np.asarray(el.to_global(X)) - 1.0)
+report("lu", n=n, factor_resid=resid, solve_resid=sres)

@@ -1,0 +1,16 @@
+"""Pseudospectral portrait of the Grcar matrix (upstream
+``examples/lapack_like/... Pseudospectra`` drivers)."""
+import numpy as np
+from _common import setup, report
+
+el, args, grid = setup()
+n = args.input("--n", "matrix size", 60)
+npts = args.input("--npts", "grid points per side", 12)
+args.process(report=True)
+
+F = np.asarray(el.to_global(el.matrices.grcar(n, grid=grid)), np.float64)
+A = el.from_global(F, el.MC, el.MR, grid=grid)
+Z, sigmin = el.pseudospectra(A, (-2.0, 3.0), (-3.5, 3.5), nx=npts, ny=npts)
+report("pseudospectra", n=n, npts=npts,
+       sigmin_min=float(np.asarray(sigmin).min()),
+       sigmin_max=float(np.asarray(sigmin).max()))

@@ -1,0 +1,21 @@
+"""QR + least squares (upstream ``examples/lapack_like/QR.cpp``)."""
+import numpy as np
+from _common import setup, report
+
+el, args, grid = setup()
+m = args.input("--m", "rows", 400)
+n = args.input("--n", "cols", 120)
+args.process(report=True)
+
+rng = np.random.default_rng(0)
+F = rng.normal(size=(m, n))
+A = el.from_global(F, el.MC, el.MR, grid=grid)
+Ap, tau = el.qr(A)
+Q = el.explicit_q(Ap, tau)
+Qg = np.asarray(el.to_global(Q))
+orth = np.linalg.norm(Qg.T @ Qg - np.eye(m))
+b = rng.normal(size=(m, 1))
+X = el.least_squares(A, el.from_global(b, el.MC, el.MR, grid=grid))
+xref, *_ = np.linalg.lstsq(F, b, rcond=None)
+err = np.linalg.norm(np.asarray(el.to_global(X)) - xref) / np.linalg.norm(xref)
+report("qr", m=m, n=n, orth=orth, lstsq_err=err)

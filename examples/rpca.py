@@ -1,0 +1,20 @@
+"""Robust PCA via ADMM+SVT (upstream ``examples/optimization/RPCA.cpp``)."""
+import numpy as np
+from _common import setup, report
+
+el, args, grid = setup()
+m = args.input("--m", "rows", 60)
+n = args.input("--n", "cols", 60)
+rk = args.input("--rank", "low rank", 3)
+args.process(report=True)
+
+rng = np.random.default_rng(0)
+Lo = rng.normal(size=(m, rk)) @ rng.normal(size=(rk, n))
+S0 = np.zeros((m, n))
+idx = rng.choice(m * n, (m * n) // 20, replace=False)
+S0.flat[idx] = rng.normal(size=idx.size) * 10
+M = el.from_global(Lo + S0, el.MC, el.MR, grid=grid)
+Lhat, Shat, info = el.rpca(M)
+err = np.linalg.norm(np.asarray(el.to_global(Lhat)) - Lo) / np.linalg.norm(Lo)
+report("rpca", m=m, n=n, rank=rk, recovery_err=err,
+       iters=info.get("iters", -1))

@@ -1,0 +1,23 @@
+"""Grid-shape sweep of the HPD solve (the topology-variation smoke the
+reference gets from its --r grid-height flag, SURVEY.md §5)."""
+import numpy as np
+from _common import setup, report
+
+el, args, grid = setup()
+n = args.input("--n", "matrix size", 160)
+args.process(report=True)
+
+import jax
+rng = np.random.default_rng(0)
+G = rng.normal(size=(n, n))
+F = G @ G.T + n * np.eye(n)
+devs = jax.devices()
+p = len(devs)
+heights = sorted({h for h in range(1, p + 1) if p % h == 0})
+for r in heights:
+    g = el.Grid(devs, height=r)
+    A = el.from_global(F, el.MC, el.MR, grid=g)
+    B = el.from_global(np.ones((n, 1)), el.MC, el.MR, grid=g)
+    X = el.hpd_solve(A, B)
+    resid = np.linalg.norm(F @ np.asarray(el.to_global(X)) - 1.0)
+    report("spd_sweep", grid=f"{r}x{p//r}", resid=float(resid))

@@ -1,0 +1,19 @@
+"""SVD driver (upstream ``examples/lapack_like/SVD.cpp``)."""
+import numpy as np
+from _common import setup, report
+
+el, args, grid = setup()
+m = args.input("--m", "rows", 250)
+n = args.input("--n", "cols", 120)
+args.process(report=True)
+
+rng = np.random.default_rng(0)
+F = rng.normal(size=(m, n))
+A = el.from_global(F, el.MC, el.MR, grid=grid)
+U, s, V = el.svd(A)
+Ug, Vg = np.asarray(el.to_global(U)), np.asarray(el.to_global(V))
+s = np.asarray(s)
+rec = np.linalg.norm(Ug @ np.diag(s) @ Vg.T - F) / np.linalg.norm(F)
+sref = np.linalg.svd(F, compute_uv=False)
+serr = np.abs(np.sort(s)[::-1] - sref).max() / sref.max()
+report("svd", m=m, n=n, reconstruct=rec, sv_err=serr)
