@@ -56,7 +56,9 @@ from .optimization import (MehrotraCtrl, lp, qp, socp, soft_threshold, svt,
                            bp, lav, nnls, lasso, svm, rpca,
                            lp_affine, qp_affine, socp_affine,
                            ruiz_equil, geom_equil, symmetric_ruiz_equil,
-                           lp_sparse, lav_sparse, bp_sparse)
+                           lp_sparse, lav_sparse, bp_sparse,
+                           cp, ds, en, nmf, sparse_inv_cov,
+                           long_only_portfolio, tv)
 from .control import sylvester, lyapunov, riccati
 from .lapack.schur import schur, triang_eig, eig, pseudospectra
 from .lapack.props import (determinant, safe_determinant, hpd_determinant,

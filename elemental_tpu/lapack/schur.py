@@ -232,16 +232,28 @@ def eig(A: DistMatrix, base: int | None = None, nb: int | None = None,
 
 
 def pseudospectra(A: DistMatrix, re_window, im_window, nx: int = 20,
-                  ny: int = 20, iters: int = 10, triangular: bool = False,
+                  ny: int = 20, iters: int = 30, triangular: bool = False,
                   base: int | None = None, nb: int | None = None,
-                  precision=None, seed: int = 0):
+                  precision=None, seed: int = 0, tol: float = 1e-3,
+                  check_every: int = 3, deflate: bool = True,
+                  snapshot=None):
     """Inverse-norm map est. sigma_min(A - z I) over a 2-D shift window
     (``El::Pseudospectra``): Schur once, then batched inverse power
     iteration on (T - z I)^H (T - z I) through ``multishift_trsm``.
 
+    Deflation (the ``Pseudospectra/{Power,Lanczos}.hpp`` machinery): every
+    ``check_every`` sweeps, shifts whose estimate moved by less than
+    ``tol`` relatively are FROZEN and removed from the batch; the active
+    set repacks to the next power-of-two width, so XLA compiles at most
+    log2(k) shapes while converged shifts stop costing solves.  The
+    ``snapshot`` callable (``SnapshotCtrl`` analog) receives
+    ``(sweep, Z, sigmin_so_far)`` after every check for progressive dumps.
+
     Returns (Z grid (ny, nx) complex, sigmin (ny, nx) float) as host numpy.
     """
     from ..blas.level3 import multishift_trsm
+    from ..redist.interior import interior_view
+    from .lu import permute_cols
     _check_mcmr(A)
     n = A.gshape[0]
     g = A.grid
@@ -252,36 +264,74 @@ def pseudospectra(A: DistMatrix, re_window, im_window, nx: int = 20,
     xs = np.linspace(re_window[0], re_window[1], nx)
     ys = np.linspace(im_window[0], im_window[1], ny)
     Z = xs[None, :] + 1j * ys[:, None]
-    shifts = jnp.asarray(Z.reshape(-1), T.dtype)
-    k = shifts.shape[0]
+    all_shifts = Z.reshape(-1)
+    k = all_shifts.shape[0]
     rng = np.random.default_rng(seed)
     V0 = rng.normal(size=(n, k)) + 1j * rng.normal(size=(n, k))
     V0 /= np.linalg.norm(V0, axis=0, keepdims=True)
     V = from_global(V0.astype(np.dtype(T.dtype)), MC, MR, grid=g)
 
-    def colnorms(X):
-        return _global_colnorms(X, k)
+    active = np.arange(k)           # global ids of live columns
+    ka = k                          # current (padded) batch width
+    sh_act = all_shifts.copy()      # length ka, padded with repeats
+    est_final = np.zeros(k)
+    prev = np.full(k, np.inf)
+    sweep = 0
 
-    cshifts = jnp.conj(shifts)     # (T - z)^H = T^H - conj(z) I
-    est = None
-    for _ in range(iters):
-        # y = (T - z)^{-1} v ; u = (T - z)^{-H} y : inverse iteration on the
-        # Hermitian product; ||y|| after normalization estimates 1/sigma_min
-        Y = multishift_trsm("U", "N", T, shifts, V, nb=nb, precision=_hi(precision))
-        ny_ = colnorms(Y)
+    def one_sweep(V, shifts_dev, cshifts_dev, width):
+        Y = multishift_trsm("U", "N", T, shifts_dev, V, nb=nb,
+                            precision=_hi(precision))
+        ny_ = _global_colnorms(Y, width)
         dinv = DistMatrix(jnp.where(ny_ > 0, 1 / jnp.where(ny_ == 0, 1, ny_),
                                     0)[:, None].astype(T.dtype),
-                          (k, 1), STAR, STAR, 0, 0, g)
+                          (width, 1), STAR, STAR, 0, 0, g)
         Yn = diagonal_scale("R", dinv, Y)
-        U = multishift_trsm("U", "C", T, cshifts, Yn, nb=nb,
+        U = multishift_trsm("U", "C", T, cshifts_dev, Yn, nb=nb,
                             precision=_hi(precision))
-        nu = colnorms(U)
+        nu = _global_colnorms(U, width)
         est = jnp.sqrt(ny_ * nu)
         dinv2 = DistMatrix(jnp.where(nu > 0, 1 / jnp.where(nu == 0, 1, nu),
                                      0)[:, None].astype(T.dtype),
-                           (k, 1), STAR, STAR, 0, 0, g)
-        V = diagonal_scale("R", dinv2, U)
-    estn = np.asarray(est)
+                           (width, 1), STAR, STAR, 0, 0, g)
+        return diagonal_scale("R", dinv2, U), est
+
+    while sweep < iters and active.size:
+        shifts_dev = jnp.asarray(sh_act, T.dtype)
+        cshifts_dev = jnp.conj(shifts_dev)
+        est = None
+        for _ in range(min(check_every, iters - sweep)):
+            V, est = one_sweep(V, shifts_dev, cshifts_dev, ka)
+            sweep += 1
+        estn = np.asarray(est)[: active.size]
+        est_final[active] = estn
+        rel = np.abs(estn - prev[active]) / np.maximum(np.abs(estn), 1e-300)
+        prev[active] = estn
+        conv = rel < tol
+        if snapshot is not None:
+            part = np.where(np.isfinite(est_final) & (est_final > 0),
+                            1.0 / np.maximum(est_final, 1e-300), 0.0)
+            snapshot(sweep, Z, part.reshape(ny, nx))
+        if not (deflate and conv.any()) or sweep >= iters:
+            if conv.all():
+                break
+            continue
+        keep = np.nonzero(~conv)[0]
+        if keep.size == 0:
+            break
+        active = active[keep]
+        # repack live columns first, pad to the next power of two -- but
+        # never GROW the batch (next_pow2(keep) can exceed a non-pow2 ka)
+        ka2 = min(ka, 1 << max(int(np.ceil(np.log2(max(keep.size, 1)))), 0))
+        pad_ids = np.concatenate(
+            [keep, np.repeat(keep[:1], ka2 - keep.size)]) \
+            if ka2 > keep.size else keep
+        Vp = permute_cols(V, jnp.asarray(
+            np.concatenate([pad_ids, np.setdiff1d(np.arange(ka), pad_ids)])
+            [:ka]))
+        V = interior_view(Vp, (0, n), (0, ka2)) if ka2 < ka else Vp
+        sh_act = sh_act[pad_ids]
+        ka = ka2
+    estn = est_final
     # exactly-singular shifts drive the solves to inf/0: sigma_min = 0 there
     sigmin = np.where(np.isfinite(estn) & (estn > 0), 1.0 / np.maximum(
         estn, 1e-300), 0.0)

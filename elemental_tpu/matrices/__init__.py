@@ -9,4 +9,8 @@ from .gallery import (
     redheffer, triw, gear, gepp_growth,
     gaussian_device, uniform_device, bernoulli, rademacher, wigner, haar,
     normal_uniform_spectrum,
+    demmel, druinsky_toledo, egorov, extended_kahan, fiedler, fox_li,
+    gks, hanowa, helmholtz_1d, helmholtz_2d, helmholtz_3d, laplacian_3d,
+    jordan_cholesky, lauchli, legendre, lotkin, one_two_one, riffle,
+    ris, whale, hatano_nelson, three_valued, kms,
 )

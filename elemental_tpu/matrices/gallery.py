@@ -380,3 +380,348 @@ def normal_uniform_spectrum(n: int, center=0.0, radius: float = 1.0,
     A = (Q * lam) @ Q.conj().T
     return from_global(A.astype(np.dtype(dtype)), MC, MR,
                        grid=grid or default_grid())
+
+
+# ---------------------------------------------------------------------
+# gallery breadth round 5 (SURVEY.md §3.5 remaining generators)
+# ---------------------------------------------------------------------
+
+def demmel(n: int, grid: Grid | None = None, dtype=jnp.float64):
+    """D[i,j] = beta^{i-j+1}-ish highly nonnormal example (``El::Demmel``):
+    B[i,j] = beta^{j-i} above the diagonal with beta = 10^{4/(n-1)}."""
+    A = _empty(n, n, grid or default_grid(), dtype)
+    beta = 10.0 ** (4.0 / max(n - 1, 1))
+
+    def f(i, j):
+        d = (j - i).astype(dtype)
+        return jnp.where(j >= i, beta ** d, 0.0)
+
+    return index_dependent_fill(A, f)
+
+
+def druinsky_toledo(k: int, grid: Grid | None = None, dtype=jnp.float64):
+    """The 2k x 2k Bunch-Kaufman growth example of Druinsky-Toledo
+    (``El::DruinskyToledo``): G = [A I; I 0]-style with A the k x k
+    lower-triangular accumulation of alpha powers."""
+    n = 2 * k
+    A = _empty(n, n, grid or default_grid(), dtype)
+    phi = (1.0 + math.sqrt(17.0)) / 8.0
+    alpha = jnp.asarray(phi, dtype)
+
+    def f(i, j):
+        in_tl = (i < k) & (j < k)
+        tl = jnp.where(i == j, 1.0,
+                       jnp.where(i > j, -(alpha ** (i - j).astype(dtype)),
+                                 0.0))
+        eye_tr = ((j >= k) & (i == j - k)).astype(dtype)
+        eye_bl = ((i >= k) & (j == i - k)).astype(dtype)
+        return jnp.where(in_tl, tl, eye_tr + eye_bl)
+
+    return index_dependent_fill(A, f)
+
+
+def egorov(fn, n: int, grid: Grid | None = None, dtype=jnp.complex128):
+    """Egorov Fourier-integral-operator matrix (``El::Egorov``):
+    A[i,j] = e^{i phi(i, j)} / sqrt(n) for a caller phase function."""
+    A = _empty(n, n, grid or default_grid(), dtype)
+    s = 1.0 / math.sqrt(n)
+    return index_dependent_fill(
+        A, lambda i, j: (s * jnp.exp(1j * fn(i, j))).astype(dtype))
+
+
+def extended_kahan(k: int, phi: float = 0.6, mu: float = 1e-5,
+                   grid: Grid | None = None, dtype=jnp.float64):
+    """The 3k x 3k extended Kahan RRQR counterexample
+    (``El::ExtendedKahan``): R = diag(zeta^i) * [[I, zeta_m H, 0],
+    [0, phi I, mu H], [0, 0, mu I]]-type block structure with H a
+    Hadamard-like reflection; built densely from the closed form."""
+    n = 3 * k
+    if k & (k - 1):
+        raise ValueError("extended_kahan needs k a power of two")
+    zeta = math.sqrt(1.0 - phi * phi)
+    # Walsh-Hadamard H_k (unnormalized +-1), closed form via bit parity
+    def had(i, j):
+        x = jnp.bitwise_and(i.astype(jnp.int32), j.astype(jnp.int32))
+        # popcount via repeated shifts (k <= 2^15 is plenty)
+        cnt = jnp.zeros_like(x)
+        for sbit in range(15):
+            cnt = cnt + jnp.bitwise_and(x >> sbit, 1)
+        return jnp.where(cnt % 2 == 0, 1.0, -1.0)
+
+    A = _empty(n, n, grid or default_grid(), dtype)
+    sk = 1.0 / math.sqrt(k)
+
+    def f(i, j):
+        bi, bj = i // k, j // k
+        ii, jj = i % k, j % k
+        blk00 = ((bi == 0) & (bj == 0) & (ii == jj)).astype(dtype)
+        blk01 = jnp.where((bi == 0) & (bj == 1),
+                          zeta * sk * had(ii, jj), 0.0)
+        blk11 = jnp.where((bi == 1) & (bj == 1) & (ii == jj), phi, 0.0)
+        blk12 = jnp.where((bi == 1) & (bj == 2),
+                          mu * sk * had(ii, jj), 0.0)
+        blk22 = jnp.where((bi == 2) & (bj == 2) & (ii == jj), mu, 0.0)
+        pre = blk00 + blk01 + blk11 + blk12 + blk22
+        return (zeta ** i.astype(dtype)) * pre
+
+    return index_dependent_fill(A, f)
+
+
+def fiedler(c, grid: Grid | None = None, dtype=None):
+    """F[i,j] = |c_i - c_j| (``El::Fiedler``)."""
+    c = jnp.asarray(c)
+    n = c.shape[0]
+    dtype = dtype or c.dtype
+    A = _empty(n, n, grid or default_grid(), dtype)
+    return index_dependent_fill(
+        A, lambda i, j: jnp.abs(jnp.take(c, jnp.clip(i, 0, n - 1))
+                                - jnp.take(c, jnp.clip(j, 0, n - 1))
+                                ).astype(dtype))
+
+
+def fox_li(n: int, omega: float = 16 * math.pi,
+           grid: Grid | None = None, dtype=jnp.complex128):
+    """Fox-Li laser cavity integral operator (``El::FoxLi``), midpoint
+    discretization on [-1, 1]: A[i,j] = sqrt(i w/pi) e^{-i w (x_i-x_j)^2} h."""
+    A = _empty(n, n, grid or default_grid(), dtype)
+    h = 2.0 / n
+    pref = jnp.sqrt(jnp.asarray(1j * omega / math.pi))
+
+    def f(i, j):
+        xi = -1.0 + (i.astype(jnp.float64) + 0.5) * h
+        xj = -1.0 + (j.astype(jnp.float64) + 0.5) * h
+        return (pref * jnp.exp(-1j * omega * (xi - xj) ** 2) * h
+                ).astype(dtype)
+
+    return index_dependent_fill(A, f)
+
+
+def gks(n: int, grid: Grid | None = None, dtype=jnp.float64):
+    """Upper triangular with G[i,i]=1/sqrt(i+1), G[i,j]=-1/sqrt(j+1) for
+    j > i (``El::GKS``, a condition-estimator counterexample)."""
+    A = _empty(n, n, grid or default_grid(), dtype)
+
+    def f(i, j):
+        rsj = 1.0 / jnp.sqrt(j.astype(dtype) + 1.0)
+        return jnp.where(i == j, rsj, jnp.where(j > i, -rsj, 0.0))
+
+    return index_dependent_fill(A, f)
+
+
+def hanowa(n: int, mu: float = -1.0, grid: Grid | None = None,
+           dtype=jnp.float64):
+    """[[mu I, -D]; [D, mu I]] with D = diag(1..n/2) (``El::Hanowa``);
+    eigenvalues mu +- i k."""
+    if n % 2:
+        raise ValueError("hanowa needs even n")
+    k = n // 2
+    A = _empty(n, n, grid or default_grid(), dtype)
+
+    def f(i, j):
+        d = (i % k).astype(dtype) + 1.0
+        diag = jnp.where(i == j, mu, 0.0)
+        tr = jnp.where((i < k) & (j == i + k), -d, 0.0)
+        bl = jnp.where((i >= k) & (j == i - k), d, 0.0)
+        return diag + tr + bl
+
+    return index_dependent_fill(A, f)
+
+
+def helmholtz_1d(n: int, shift: float, grid: Grid | None = None,
+                 dtype=jnp.float64):
+    """1-D Laplacian minus a shift (``El::Helmholtz``)."""
+    return shift_diagonal(laplacian_1d(n, grid=grid, dtype=dtype), -shift)
+
+
+def helmholtz_2d(nx: int, ny: int, shift: float, grid: Grid | None = None,
+                 dtype=jnp.float64):
+    """2-D Helmholtz (``El::Helmholtz``)."""
+    return shift_diagonal(laplacian_2d(nx, ny, grid=grid, dtype=dtype),
+                          -shift)
+
+
+def helmholtz_3d(nx: int, ny: int, nz: int, shift: float,
+                 grid: Grid | None = None, dtype=jnp.float64):
+    """3-D Helmholtz on the nx*ny*nz grid (7-point stencil)."""
+    return shift_diagonal(laplacian_3d(nx, ny, nz, grid=grid, dtype=dtype),
+                          -shift)
+
+
+def laplacian_3d(nx: int, ny: int, nz: int, grid: Grid | None = None,
+                 dtype=jnp.float64):
+    """Negative 3-D Dirichlet Laplacian, 7-point stencil, lexicographic
+    (x fastest) ordering (``El::Laplacian`` 3-D overload)."""
+    n = nx * ny * nz
+    h2x, h2y, h2z = (nx + 1.0) ** 2, (ny + 1.0) ** 2, (nz + 1.0) ** 2
+    A = _empty(n, n, grid or default_grid(), dtype)
+
+    def f(i, j):
+        xi, yi, zi = i % nx, (i // nx) % ny, i // (nx * ny)
+        xj, yj, zj = j % nx, (j // nx) % ny, j // (nx * ny)
+        diag = jnp.where(i == j, 2.0 * (h2x + h2y + h2z), 0.0)
+        ex = jnp.where((zi == zj) & (yi == yj)
+                       & (jnp.abs(xi - xj) == 1), -h2x, 0.0)
+        ey = jnp.where((zi == zj) & (xi == xj)
+                       & (jnp.abs(yi - yj) == 1), -h2y, 0.0)
+        ez = jnp.where((yi == yj) & (xi == xj)
+                       & (jnp.abs(zi - zj) == 1), -h2z, 0.0)
+        return (diag + ex + ey + ez).astype(dtype)
+
+    return index_dependent_fill(A, f)
+
+
+def jordan_cholesky(n: int, grid: Grid | None = None, dtype=jnp.float64):
+    """J^T J for the Jordan block J with eigenvalue 2 and unit
+    superdiagonal (``El::JordanCholesky``): tridiagonal with diagonal
+    (4, 5, 5, ..., 5) and off-diagonals 2 -- an SPD matrix whose
+    Cholesky factor is exactly that Jordan block transposed."""
+    A = _empty(n, n, grid or default_grid(), dtype)
+
+    def f(i, j):
+        diag = jnp.where(i == j, jnp.where(i == 0, 4.0, 5.0), 0.0)
+        off = jnp.where(jnp.abs(i - j) == 1, 2.0, 0.0)
+        return (diag + off).astype(dtype)
+
+    return index_dependent_fill(A, f)
+
+
+def lauchli(n: int, mu: float | None = None, grid: Grid | None = None,
+            dtype=jnp.float64):
+    """(n+1) x n [ones_row; mu I] (``El::Lauchli``), the classic
+    normal-equations ill-conditioning example."""
+    mu = mu if mu is not None else math.sqrt(np.finfo(np.float64).eps)
+    A = _empty(n + 1, n, grid or default_grid(), dtype)
+
+    def f(i, j):
+        return jnp.where(i == 0, 1.0,
+                         jnp.where(i == j + 1, mu, 0.0)).astype(dtype)
+
+    return index_dependent_fill(A, f)
+
+
+def legendre(n: int, grid: Grid | None = None, dtype=jnp.float64):
+    """Jacobi (tridiagonal) matrix of the Legendre recurrence
+    (``El::Legendre``): beta_k = 1/(2 sqrt(1 - (2k)^{-2})) off-diagonal."""
+    A = _empty(n, n, grid or default_grid(), dtype)
+
+    def f(i, j):
+        k = jnp.maximum(i, j).astype(dtype)       # = min+1 on the off-diag
+        beta = 0.5 / jnp.sqrt(1.0 - 1.0 / (4.0 * k * k))
+        return jnp.where(jnp.abs(i - j) == 1, beta, 0.0).astype(dtype)
+
+    return index_dependent_fill(A, f)
+
+
+def lotkin(n: int, grid: Grid | None = None, dtype=jnp.float64):
+    """Hilbert matrix with the first row set to ones (``El::Lotkin``)."""
+    A = _empty(n, n, grid or default_grid(), dtype)
+
+    def f(i, j):
+        h = 1.0 / (i.astype(dtype) + j.astype(dtype) + 1.0)
+        return jnp.where(i == 0, 1.0, h)
+
+    return index_dependent_fill(A, f)
+
+
+def one_two_one(n: int, grid: Grid | None = None, dtype=jnp.float64):
+    """Tridiagonal (1, 2, 1) (``El::OneTwoOne``)."""
+    A = _empty(n, n, grid or default_grid(), dtype)
+
+    def f(i, j):
+        return jnp.where(i == j, 2.0,
+                         jnp.where(jnp.abs(i - j) == 1, 1.0, 0.0)
+                         ).astype(dtype)
+
+    return index_dependent_fill(A, f)
+
+
+def riffle(n: int, grid: Grid | None = None, dtype=jnp.float64):
+    """Gilbert-Shannon-Reeds riffle-shuffle transition matrix
+    (``El::Riffle``): P[i,j] = C(n+1, 2j-i+1) * 2^{-n} * A_n-ish; we use
+    the standard closed form P[i,j] = 2^{-n} C(n+1, 2(j+1)-(i+1))
+    ... with the Eulerian normalization left to the caller."""
+    A = _empty(n, n, grid or default_grid(), dtype)
+    # log-binomials, precomputed host-side (O(n))
+    lg = np.concatenate([[0.0], np.cumsum(np.log(np.arange(1, n + 2)))])
+    lgj = jnp.asarray(lg)
+
+    def f(i, j):
+        k = 2 * (j + 1) - (i + 1)
+        valid = (k >= 0) & (k <= n + 1)
+        kc = jnp.clip(k, 0, n + 1)
+        logbin = lgj[n + 1] - lgj[kc] - lgj[n + 1 - kc]
+        return jnp.where(valid,
+                         jnp.exp(logbin - n * math.log(2.0)),
+                         0.0).astype(dtype)
+
+    return index_dependent_fill(A, f)
+
+
+def ris(n: int, grid: Grid | None = None, dtype=jnp.float64):
+    """R[i,j] = 0.5/(n - i - j - 0.5) (``El::Ris``)."""
+    A = _empty(n, n, grid or default_grid(), dtype)
+    return index_dependent_fill(
+        A, lambda i, j: (0.5 / (n - i.astype(dtype) - j.astype(dtype)
+                                - 0.5)))
+
+
+def whale(n: int, grid: Grid | None = None, dtype=jnp.complex128):
+    """The "whale" pseudospectrum example (Trefethen-Embree): banded
+    Toeplitz with symbol z^{-4} + (3+2i) z^{-3} - (1+2i) z^{-2} + z^{-1}
+    + 10 z + (3+i) z^2 + 4 z^3 + i z^4 (``El::Whale``)."""
+    coef = {-4: 1.0, -3: 3.0 + 2.0j, -2: -(1.0 + 2.0j), -1: 1.0,
+            1: 10.0, 2: 3.0 + 1.0j, 3: 4.0, 4: 1.0j}
+    # A[i,j] = a_{i-j}: positive symbol powers sit BELOW the diagonal
+    col = np.zeros(n, np.complex128)
+    row = np.zeros(n, np.complex128)
+    for off, v in coef.items():
+        if off >= 0 and off < n:
+            col[off] = v
+        elif off < 0 and -off < n:
+            row[-off] = v
+    return toeplitz(jnp.asarray(col), jnp.asarray(row), grid=grid,
+                    dtype=dtype)
+
+
+def hatano_nelson(n: int, shift: float = 0.0, g: float = 0.5,
+                  periodic: bool = True, grid: Grid | None = None,
+                  dtype=jnp.float64, seed: int = 0):
+    """Hatano-Nelson non-Hermitian localization model
+    (``El::HatanoNelson``): random diagonal + e^{+-g} hopping."""
+    rng = np.random.default_rng(seed)
+    d = jnp.asarray(rng.uniform(-1, 1, n) - shift, dtype)
+    A = _empty(n, n, grid or default_grid(), dtype)
+    eg, emg = math.exp(g), math.exp(-g)
+
+    def f(i, j):
+        diag = jnp.where(i == j, jnp.take(d, jnp.clip(i, 0, n - 1)), 0.0)
+        up = jnp.where(j == i + 1, eg, 0.0)
+        dn = jnp.where(j == i - 1, emg, 0.0)
+        wrap = 0.0
+        if periodic and n > 2:
+            wrap = jnp.where((i == n - 1) & (j == 0), eg, 0.0) \
+                + jnp.where((i == 0) & (j == n - 1), emg, 0.0)
+        return (diag + up + dn + wrap).astype(dtype)
+
+    return index_dependent_fill(A, f)
+
+
+def three_valued(m: int, n: int | None = None, p: float = 2.0 / 3.0,
+                 grid: Grid | None = None, dtype=jnp.float64,
+                 seed: int = 0):
+    """Random {-1, 0, +1} entries: 0 w.p. 1-p, +-1 w.p. p/2 each
+    (``El::ThreeValued``)."""
+    n = n if n is not None else m
+    rng = np.random.default_rng(seed)
+    u = rng.uniform(size=(m, n))
+    vals = np.where(u < p / 2, -1.0, np.where(u < p, 1.0, 0.0))
+    return from_global(jnp.asarray(vals, dtype), MC, MR,
+                       grid=grid or default_grid())
+
+
+def kms(n: int, rho: float = 0.5, grid: Grid | None = None,
+        dtype=jnp.float64):
+    """Kac-Murdock-Szego Toeplitz K[i,j] = rho^{|i-j|} (``El::KMS``)."""
+    A = _empty(n, n, grid or default_grid(), dtype)
+    return index_dependent_fill(
+        A, lambda i, j: (rho ** jnp.abs(i - j).astype(dtype)))

@@ -221,21 +221,32 @@ class _Soc:
 
 def _conic_mehrotra(Q, A, G, b, c, h, cone, ctrl, nb, precision,
                     equilibrate=True):
-    """Shared core; Q may be None (LP/SOCP).  Operands are [MC,MR]
+    """Shared core; Q may be None (LP/SOCP) and (A, b) may be None (no
+    equality constraints -- CP/TV-style models).  Operands are [MC,MR]
     DistMatrices; returns host vectors (x, y, z, s, info)."""
-    _check_mcmr(A, G, b, c, h)
-    m, n = A.gshape
-    k = G.gshape[0]
-    g = A.grid
+    _check_mcmr(*(X for X in (A, G, b, c, h) if X is not None))
+    k, n = G.gshape
+    m = A.gshape[0] if A is not None else 0
+    g = G.grid
 
     d_rA = np.ones(m); d_rG = np.ones(k); d_c = np.ones(n)
     if equilibrate:
-        A, G, d_rA, d_rG, d_c = ruiz_equil_stacked(
-            A, G, first_inds=cone.first_inds)
+        if A is not None:
+            A, G, d_rA, d_rG, d_c = ruiz_equil_stacked(
+                A, G, first_inds=cone.first_inds)
+        elif cone.first_inds is None:
+            # A-free pos-orth path (CP/TV-style models): plain Ruiz on G
+            from .equilibrate import ruiz_equil
+            G, d_rG0, d_c0 = ruiz_equil(G)
+            d_rG = np.asarray(d_rG0)
+            d_c = np.asarray(d_c0)
+        # (A-free SOC problems skip equilibration: pooling G's rows per
+        # cone without the stacked column pass buys little)
 
-    An = np.asarray(to_global(A))
+    An = np.asarray(to_global(A)) if A is not None else np.zeros((0, n))
     Gn = np.asarray(to_global(G))
-    bn = np.asarray(to_global(b)).ravel() * d_rA
+    bn = (np.asarray(to_global(b)).ravel() * d_rA) if b is not None \
+        else np.zeros(0)
     cn = np.asarray(to_global(c)).ravel() * d_c
     hn = np.asarray(to_global(h)).ravel() * d_rG
     Qn = None
@@ -243,17 +254,18 @@ def _conic_mehrotra(Q, A, G, b, c, h, cone, ctrl, nb, precision,
         Qn = np.asarray(to_global(Q)) * d_c[:, None] * d_c[None, :]
 
     def dmat(M):
-        return from_global(np.asarray(M, An.dtype), MC, MR, grid=g)
+        return from_global(np.asarray(M, Gn.dtype), MC, MR, grid=g)
 
     N = n + m + k
 
     def kkt_factor(H):
-        Kd = _blank(N, N, A)
+        Kd = _blank(N, N, G)
         if Qn is not None:
             Kd = interior_update(Kd, dmat(Qn), (0, 0))
-        Kd = interior_update(Kd, dmat(An.T), (0, n))
+        if m > 0:
+            Kd = interior_update(Kd, dmat(An.T), (0, n))
+            Kd = interior_update(Kd, dmat(An), (n, 0))
         Kd = interior_update(Kd, dmat(Gn.T), (0, n + m))
-        Kd = interior_update(Kd, dmat(An), (n, 0))
         Kd = interior_update(Kd, dmat(Gn), (n + m, 0))
         Kd = interior_update(Kd, dmat(-H), (n + m, n + m))
         return ldl(Kd, conjugate=False, nb=nb, precision=precision)

@@ -102,3 +102,24 @@ def test_pseudospectra_map(grid24):
                                       compute_uv=False)[-1]
                         for z in row] for row in Z])
     assert np.max(np.abs(sm - direct) / np.maximum(direct, 1e-12)) < 1e-3
+
+
+def test_pseudospectra_deflation_matches(grid24):
+    """Deflated and non-deflated runs agree; snapshots fire (the
+    SnapshotCtrl analog)."""
+    import numpy as np
+    rng = np.random.default_rng(11)
+    n = 24
+    F = rng.normal(size=(n, n))
+    A = el.from_global(F, el.MC, el.MR, grid=grid24)
+    snaps = []
+    Z1, s1 = el.pseudospectra(A, (-3, 3), (-3, 3), nx=5, ny=4, iters=24,
+                              tol=1e-5, deflate=True,
+                              snapshot=lambda it, Z, S: snaps.append(it))
+    Z2, s2 = el.pseudospectra(A, (-3, 3), (-3, 3), nx=5, ny=4, iters=24,
+                              tol=1e-5, deflate=False)
+    assert snaps, "snapshot callback never fired"
+    ok = (s1 > 0) & (s2 > 0)
+    assert ok.mean() > 0.9
+    rel = np.abs(s1[ok] - s2[ok]) / np.maximum(s2[ok], 1e-300)
+    assert np.median(rel) < 5e-2

@@ -133,3 +133,171 @@ def test_print_matrix(grid24, capsys):
     el.print_matrix(A, title="T", stream=buf)
     out = buf.getvalue()
     assert "T" in out and "5." in out
+
+
+# ---------------------------------------------------------------------
+# round-5 breadth generators
+# ---------------------------------------------------------------------
+
+class TestGalleryBreadth:
+    def test_demmel(self, grid24):
+        import numpy as np
+        D = np.asarray(el.to_global(el.matrices.demmel(8, grid=grid24)))
+        beta = 10.0 ** (4.0 / 7)
+        assert np.allclose(np.diag(D), 1.0)
+        assert np.isclose(D[0, 7], beta ** 7)
+        assert np.allclose(np.tril(D, -1), 0)
+
+    def test_druinsky_toledo(self, grid24):
+        import numpy as np
+        G = np.asarray(el.to_global(
+            el.matrices.druinsky_toledo(4, grid=grid24)))
+        assert G.shape == (8, 8)
+        assert np.allclose(np.diag(G[:4, :4]), 1.0)
+        assert np.allclose(G[:4, 4:], np.eye(4))
+        assert np.allclose(G[4:, :4], np.eye(4))
+        assert np.allclose(G[4:, 4:], 0)
+
+    def test_extended_kahan_triangular(self, grid24):
+        import numpy as np
+        R = np.asarray(el.to_global(
+            el.matrices.extended_kahan(4, grid=grid24)))
+        assert R.shape == (12, 12)
+        assert np.allclose(np.tril(R, -1), 0)   # upper triangular
+        assert np.linalg.matrix_rank(R) == 12
+
+    def test_fiedler(self, grid24):
+        import numpy as np
+        c = np.array([0.0, 1.0, 3.0, 7.0])
+        F = np.asarray(el.to_global(el.matrices.fiedler(c, grid=grid24)))
+        assert np.allclose(F, np.abs(c[:, None] - c[None, :]))
+
+    def test_fox_li_nonnormal(self, grid24):
+        import numpy as np
+        A = np.asarray(el.to_global(el.matrices.fox_li(24, grid=grid24)))
+        assert A.shape == (24, 24)
+        assert np.linalg.norm(A @ A.conj().T - A.conj().T @ A) > 1e-8
+
+    def test_gks(self, grid24):
+        import numpy as np
+        G = np.asarray(el.to_global(el.matrices.gks(6, grid=grid24)))
+        assert np.allclose(np.diag(G), 1 / np.sqrt(np.arange(1, 7)))
+        assert np.isclose(G[0, 3], -0.5)
+
+    def test_hanowa_spectrum(self, grid24):
+        import numpy as np
+        H = np.asarray(el.to_global(
+            el.matrices.hanowa(8, mu=-1.0, grid=grid24)))
+        w = np.linalg.eigvals(H)
+        assert np.allclose(np.sort(w.real), -np.ones(8))
+        assert np.allclose(np.sort(np.abs(w.imag)),
+                           np.sort(np.abs(np.r_[1:5, 1:5] * 1.0)))
+
+    def test_helmholtz_shift(self, grid24):
+        import numpy as np
+        L = np.asarray(el.to_global(
+            el.matrices.laplacian_1d(9, grid=grid24)))
+        H = np.asarray(el.to_global(
+            el.matrices.helmholtz_1d(9, 2.5, grid=grid24)))
+        assert np.allclose(H, L - 2.5 * np.eye(9))
+
+    def test_laplacian_3d_spd(self, grid24):
+        import numpy as np
+        L = np.asarray(el.to_global(
+            el.matrices.laplacian_3d(3, 3, 3, grid=grid24)))
+        assert np.allclose(L, L.T)
+        assert np.linalg.eigvalsh(L).min() > 0
+        # 7-point stencil: interior row has exactly 7 nonzeros
+        assert (np.abs(L[13]) > 0).sum() == 7
+
+    def test_jordan_cholesky(self, grid24):
+        import numpy as np
+        C = np.asarray(el.to_global(
+            el.matrices.jordan_cholesky(6, grid=grid24)))
+        # C = B^T B with B the Jordan block (diag 2, superdiag 1)
+        B = np.eye(6) * 2.0
+        B[np.arange(5), np.arange(1, 6)] = 1.0
+        assert np.allclose(C, B.T @ B)
+
+    def test_lauchli(self, grid24):
+        import numpy as np
+        A = np.asarray(el.to_global(
+            el.matrices.lauchli(5, mu=1e-4, grid=grid24)))
+        assert A.shape == (6, 5)
+        assert np.allclose(A[0], 1.0)
+        assert np.allclose(A[1:], 1e-4 * np.eye(5))
+
+    def test_legendre_eigs_in_unit_interval(self, grid24):
+        import numpy as np
+        J = np.asarray(el.to_global(el.matrices.legendre(12, grid=grid24)))
+        assert np.allclose(J, J.T)
+        w = np.linalg.eigvalsh(J)
+        assert w.min() > -1 and w.max() < 1     # Gauss-Legendre nodes
+
+    def test_lotkin(self, grid24):
+        import numpy as np
+        L = np.asarray(el.to_global(el.matrices.lotkin(5, grid=grid24)))
+        assert np.allclose(L[0], 1.0)
+        H = 1.0 / (np.arange(5)[:, None] + np.arange(5)[None, :] + 1.0)
+        assert np.allclose(L[1:], H[1:])
+
+    def test_one_two_one_spectrum(self, grid24):
+        import numpy as np
+        T = np.asarray(el.to_global(el.matrices.one_two_one(10, grid=grid24)))
+        w = np.linalg.eigvalsh(T)
+        k = np.arange(1, 11)
+        assert np.allclose(np.sort(w), np.sort(2 + 2 * np.cos(k * np.pi / 11)))
+
+    def test_riffle_stochastic(self, grid24):
+        import numpy as np
+        P = np.asarray(el.to_global(el.matrices.riffle(6, grid=grid24)))
+        assert np.all(P >= 0)
+
+    def test_ris(self, grid24):
+        import numpy as np
+        R = np.asarray(el.to_global(el.matrices.ris(6, grid=grid24)))
+        i, j = np.meshgrid(np.arange(6), np.arange(6), indexing="ij")
+        assert np.allclose(R, 0.5 / (6 - i - j - 0.5))
+
+    def test_whale_banded_toeplitz(self, grid24):
+        import numpy as np
+        W = np.asarray(el.to_global(el.matrices.whale(12, grid=grid24)))
+        assert np.isclose(W[1, 0], 10.0)        # z^1 coefficient below diag
+        assert np.isclose(W[0, 1], 1.0)         # z^{-1} above
+        assert np.isclose(W[0, 4], 1.0)         # z^{-4}
+        # Toeplitz: constant diagonals
+        assert np.allclose(np.diag(W, 2), W[0, 2])
+        assert np.allclose(np.diag(W, -2), W[2, 0])
+
+    def test_hatano_nelson(self, grid24):
+        import numpy as np
+        H = np.asarray(el.to_global(
+            el.matrices.hatano_nelson(8, g=0.5, grid=grid24)))
+        assert np.allclose(np.diag(H, 1), np.exp(0.5))
+        assert np.allclose(np.diag(H, -1), np.exp(-0.5))
+        assert np.isclose(H[7, 0], np.exp(0.5))     # periodic wrap
+
+    def test_three_valued(self, grid24):
+        import numpy as np
+        T = np.asarray(el.to_global(
+            el.matrices.three_valued(40, 40, grid=grid24)))
+        assert set(np.unique(T)).issubset({-1.0, 0.0, 1.0})
+        frac = (T != 0).mean()
+        assert 0.4 < frac < 0.9
+
+    def test_kms_inverse_tridiagonal(self, grid24):
+        import numpy as np
+        K = np.asarray(el.to_global(el.matrices.kms(8, 0.5, grid=grid24)))
+        # KMS inverses are tridiagonal -- the classic identity
+        Kinv = np.linalg.inv(K)
+        off2 = Kinv - np.diag(np.diag(Kinv)) \
+            - np.diag(np.diag(Kinv, 1), 1) - np.diag(np.diag(Kinv, -1), -1)
+        assert np.abs(off2).max() < 1e-10
+
+    def test_egorov_unimodular(self, grid24):
+        import numpy as np
+        import jax.numpy as jnp
+        A = np.asarray(el.to_global(el.matrices.egorov(
+            lambda i, j: (i * j).astype(jnp.float64) * 0.1, 10,
+            grid=grid24)))
+        assert np.allclose(np.abs(A), 1.0 / np.sqrt(10))

@@ -1,0 +1,133 @@
+"""Round-5 model breadth oracles (CP, DS, EN, NMF, SparseInvCov,
+LongOnlyPortfolio, TV -- the remaining src/optimization/models/** rows).
+
+Oracles follow SURVEY.md §5: scipy/HiGHS objective agreement where an LP
+oracle exists, otherwise optimality conditions / known closed forms.
+"""
+import numpy as np
+import pytest
+
+import elemental_tpu as el
+
+
+def _g(F, grid):
+    return el.from_global(np.atleast_2d(np.asarray(F, np.float64)),
+                         el.MC, el.MR, grid=grid)
+
+
+def test_cp_chebyshev(grid24):
+    rng = np.random.default_rng(0)
+    m, n = 40, 8
+    A = rng.normal(size=(m, n))
+    b = rng.normal(size=m)
+    x, info = el.cp(_g(A, grid24), _g(b.reshape(-1, 1), grid24))
+    assert info["converged"], info
+    from scipy.optimize import linprog
+    G = np.block([[A, -np.ones((m, 1))], [-A, -np.ones((m, 1))]])
+    h = np.concatenate([b, -b])
+    c = np.concatenate([np.zeros(n), [1.0]])
+    res = linprog(c, A_ub=G, b_ub=h, bounds=[(None, None)] * (n + 1),
+                  method="highs")
+    assert abs(np.abs(A @ x - b).max() - res.fun) / (1 + res.fun) < 1e-5
+
+
+def test_ds_dantzig_selector(grid24):
+    rng = np.random.default_rng(1)
+    m, n = 30, 10
+    A = rng.normal(size=(m, n))
+    xs = np.zeros(n); xs[[1, 4]] = [2.0, -3.0]
+    b = A @ xs
+    lam = 0.5
+    x, info = el.ds(_g(A, grid24), _g(b.reshape(-1, 1), grid24), lam)
+    assert info["converged"], info
+    # feasibility + near-support recovery
+    assert np.abs(A.T @ (b - A @ x)).max() <= lam + 1e-5
+    assert np.abs(x).sum() <= np.abs(xs).sum() + 1e-4
+
+
+def test_en_elastic_net(grid24):
+    rng = np.random.default_rng(2)
+    m, n = 40, 12
+    A = rng.normal(size=(m, n))
+    b = rng.normal(size=m)
+    lam1, lam2 = 0.7, 0.3
+    x, info = el.en(_g(A, grid24), _g(b.reshape(-1, 1), grid24), lam1, lam2)
+    assert info["converged"], info
+
+    def obj(v):
+        return 0.5 * np.sum((A @ v - b) ** 2) + lam1 * np.abs(v).sum() \
+            + 0.5 * lam2 * np.sum(v * v)
+    # subgradient optimality: our objective beats small perturbations
+    f0 = obj(x)
+    for _ in range(30):
+        assert f0 <= obj(x + 1e-3 * rng.normal(size=n)) + 1e-9
+
+
+def test_nmf(grid24):
+    rng = np.random.default_rng(3)
+    m, n, rk = 30, 24, 4
+    W0 = np.abs(rng.normal(size=(m, rk)))
+    H0 = np.abs(rng.normal(size=(rk, n)))
+    X = W0 @ H0
+    W, H, info = el.nmf(_g(X, grid24), rk, max_iters=400)
+    Wg = np.asarray(el.to_global(W))
+    Hg = np.asarray(el.to_global(H))
+    assert np.all(Wg >= 0) and np.all(Hg >= 0)
+    assert info["rel_err"] < 5e-2
+    assert np.linalg.norm(Wg @ Hg - X) / np.linalg.norm(X) < 5e-2
+
+
+def test_sparse_inv_cov(grid24):
+    rng = np.random.default_rng(4)
+    n, N = 10, 4000
+    # sparse tridiagonal precision matrix ground truth
+    P = np.eye(n) * 2.0
+    P[np.arange(1, n), np.arange(n - 1)] = 0.6
+    P[np.arange(n - 1), np.arange(1, n)] = 0.6
+    C = np.linalg.inv(P)
+    Xs = rng.multivariate_normal(np.zeros(n), C, size=N)
+    S = np.cov(Xs.T)
+    lam = 0.05
+    X, info = el.sparse_inv_cov(_g(S, grid24), lam, max_iters=200)
+    Xg = np.asarray(el.to_global(X))
+    assert np.allclose(Xg, Xg.T, atol=1e-8)
+    # optimality of the smooth part on the support (KKT of glasso):
+    # S - X^{-1} + lam * sign(X) ~ 0 on nonzeros, |.| <= lam on zeros
+    Xinv = np.linalg.inv(Xg + 1e-12 * np.eye(n))
+    grad = S - Xinv
+    on = np.abs(Xg) > 1e-6
+    assert np.abs(grad[on] + lam * np.sign(Xg[on])).max() < 5e-2
+    assert np.abs(grad[~on]).max() <= lam + 5e-2
+
+
+def test_long_only_portfolio(grid24):
+    rng = np.random.default_rng(5)
+    n = 8
+    G0 = rng.normal(size=(n, n))
+    Sigma = G0 @ G0.T / n + 0.1 * np.eye(n)
+    mu = rng.uniform(0.0, 0.2, n)
+    x, info = el.long_only_portfolio(_g(Sigma, grid24), mu, gamma=0.5)
+    assert info["converged"], info
+    assert abs(x.sum() - 1.0) < 1e-6
+    assert x.min() > -1e-7
+    # objective beats uniform and single-asset corners
+    def obj(v):
+        return -mu @ v + 0.5 * np.sqrt(v @ Sigma @ v)
+    assert obj(x) <= obj(np.ones(n) / n) + 1e-6
+    for i in range(n):
+        e = np.zeros(n); e[i] = 1.0
+        assert obj(x) <= obj(e) + 1e-6
+
+
+def test_tv_denoise(grid24):
+    rng = np.random.default_rng(6)
+    n = 60
+    truth = np.concatenate([np.zeros(n // 3), np.ones(n // 3) * 2,
+                            np.zeros(n - 2 * (n // 3))])
+    b = truth + 0.15 * rng.normal(size=n)
+    x, info = el.tv(b, lam=1.0, grid=grid24)
+    assert info["converged"], info
+    # denoised signal is closer to the truth than the data, and
+    # piecewise-flat (small total variation)
+    assert np.linalg.norm(x - truth) < np.linalg.norm(b - truth)
+    assert np.abs(np.diff(x)).sum() < np.abs(np.diff(b)).sum() / 3
