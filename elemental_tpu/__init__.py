@@ -38,7 +38,7 @@ from .blas import (axpy, scale, fill, entrywise_map, hadamard,
                    scale_trapezoid, axpy_trapezoid, safe_scale,
                    get_submatrix, set_submatrix)
 from .lapack import (cholesky, hpd_solve, cholesky_solve_after,
-                     cholesky_pivoted)
+                     cholesky_pivoted, cholesky_mod)
 from .lapack import (lu, lu_solve, lu_solve_after, permute_rows,
                      permute_cols, lu_full_pivot)
 from .lapack import (qr, apply_q, explicit_q, least_squares, tsqr, lq,

@@ -1,7 +1,7 @@
 """LAPACK-like layer: factorizations, solves, spectral (growing per
 SURVEY.md §3.4 / §8.2)."""
 from .cholesky import (cholesky, hpd_solve, cholesky_solve_after,
-                       cholesky_pivoted)
+                       cholesky_pivoted, cholesky_mod)
 from .lu import (lu, lu_solve, lu_solve_after, permute_rows, permute_cols,
                  lu_full_pivot)
 from .qr import (qr, apply_q, explicit_q, least_squares, tsqr, lq,

@@ -261,3 +261,59 @@ def cholesky_pivoted(A: DistMatrix, tol: float = 0.0, precision=None):
     Ld = redistribute(DistMatrix(L.astype(A.dtype), (n, n), STAR, STAR,
                                  0, 0, g), MC, MR)
     return Ld, perm, rank
+
+
+def cholesky_mod(L: DistMatrix, V: DistMatrix, alpha: float = 1.0,
+                 precision=None):
+    """Rank-k Cholesky modification (``El::CholeskyMod``,
+    ``Cholesky/{LMod,UMod}.hpp``): given lower L with A = L L^H, return
+    the factor of ``A + alpha V V^H`` in O(n^2 k) via the classic
+    column-recurrence (one hyperbolic/Givens sweep per update vector).
+
+    ``alpha < 0`` is a DOWNDATE and requires the result to stay positive
+    definite (the sweep's r^2 staying positive); like the pivoted
+    variants, the sweep runs replicated on the gathered factor (it is a
+    latency-bound sequential recurrence -- the reference's is too) and
+    scatters back."""
+    _check_mcmr(L, V)
+    if jnp.issubdtype(L.dtype, jnp.complexfloating):
+        raise NotImplementedError("cholesky_mod supports real factors")
+    n = L.gshape[0]
+    if V.gshape[0] != n:
+        raise ValueError(f"V rows {V.gshape[0]} != n {n}")
+    k = V.gshape[1]
+    g = L.grid
+    a = jnp.tril(redistribute(L, STAR, STAR).local)
+    W = redistribute(V, STAR, STAR).local.astype(a.dtype)
+    sign = 1.0 if alpha >= 0 else -1.0
+    scal = math.sqrt(abs(alpha))
+    idx = jnp.arange(n)
+
+    def one_vector(a, w):
+        def body(j, state):
+            a, w = state
+            ljj = a[j, j]
+            wj = w[j]
+            # an indefinite downdate makes r2 negative: sqrt -> NaN, which
+            # poisons the factor and is caught by the host check below
+            r = jnp.sqrt(ljj * ljj + sign * wj * wj)
+            c = r / ljj
+            s = wj / ljj
+            col = a[:, j]
+            newcol = (col + sign * s * w) / c
+            newcol = jnp.where(idx > j, newcol, col).at[j].set(r)
+            wnew = jnp.where(idx > j, c * w - s * newcol, w)
+            return a.at[:, j].set(newcol), wnew
+
+        a, _ = lax.fori_loop(0, n, body, (a, w))
+        return a
+
+    for t in range(k):
+        a = one_vector(a, scal * W[:, t])
+    import numpy as _np
+    if not bool(_np.isfinite(_np.asarray(jnp.diagonal(a))).all()):
+        raise ValueError("cholesky_mod: downdate leaves the matrix "
+                         "indefinite (El::CholeskyMod throws here too)")
+    out = redistribute(DistMatrix(jnp.tril(a), (n, n), STAR, STAR, 0, 0, g),
+                       MC, MR)
+    return out

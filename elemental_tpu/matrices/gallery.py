@@ -549,22 +549,25 @@ def helmholtz_3d(nx: int, ny: int, nz: int, shift: float,
 
 def laplacian_3d(nx: int, ny: int, nz: int, grid: Grid | None = None,
                  dtype=jnp.float64):
-    """Negative 3-D Dirichlet Laplacian, 7-point stencil, lexicographic
-    (x fastest) ordering (``El::Laplacian`` 3-D overload)."""
+    """Negative 3-D Dirichlet Laplacian, 7-point stencil (diag 6, off -1),
+    lexicographic (x fastest) ordering (``El::Laplacian`` 3-D overload).
+
+    Family convention: like :func:`laplacian_1d`/:func:`laplacian_2d`,
+    the stencil is UNSCALED (upstream multiplies each dimension by
+    hInv^2 = (n+1)^2; scale the shift accordingly when porting)."""
     n = nx * ny * nz
-    h2x, h2y, h2z = (nx + 1.0) ** 2, (ny + 1.0) ** 2, (nz + 1.0) ** 2
     A = _empty(n, n, grid or default_grid(), dtype)
 
     def f(i, j):
         xi, yi, zi = i % nx, (i // nx) % ny, i // (nx * ny)
         xj, yj, zj = j % nx, (j // nx) % ny, j // (nx * ny)
-        diag = jnp.where(i == j, 2.0 * (h2x + h2y + h2z), 0.0)
+        diag = jnp.where(i == j, 6.0, 0.0)
         ex = jnp.where((zi == zj) & (yi == yj)
-                       & (jnp.abs(xi - xj) == 1), -h2x, 0.0)
+                       & (jnp.abs(xi - xj) == 1), -1.0, 0.0)
         ey = jnp.where((zi == zj) & (xi == xj)
-                       & (jnp.abs(yi - yj) == 1), -h2y, 0.0)
+                       & (jnp.abs(yi - yj) == 1), -1.0, 0.0)
         ez = jnp.where((yi == yj) & (xi == xj)
-                       & (jnp.abs(zi - zj) == 1), -h2z, 0.0)
+                       & (jnp.abs(zi - zj) == 1), -1.0, 0.0)
         return (diag + ex + ey + ez).astype(dtype)
 
     return index_dependent_fill(A, f)

@@ -224,6 +224,8 @@ def _conic_mehrotra(Q, A, G, b, c, h, cone, ctrl, nb, precision,
     """Shared core; Q may be None (LP/SOCP) and (A, b) may be None (no
     equality constraints -- CP/TV-style models).  Operands are [MC,MR]
     DistMatrices; returns host vectors (x, y, z, s, info)."""
+    if (A is None) != (b is None):
+        raise ValueError("A and b must be supplied together (or both None)")
     _check_mcmr(*(X for X in (A, G, b, c, h) if X is not None))
     k, n = G.gshape
     m = A.gshape[0] if A is not None else 0

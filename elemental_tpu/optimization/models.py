@@ -284,6 +284,7 @@ def nmf(X: DistMatrix, rank: int, max_iters: int = 200, tol: float = 1e-5,
     H = _from_np(np.abs(rng.normal(size=(rank, n))) + 0.1, g)
     eps = 1e-12
     last = np.inf
+    nrmX = max(float(frobenius_norm(X)), 1e-30)
     info = {"iters": 0}
     for it in range(max_iters):
         # H <- H * (W'X) / (W'W H)
@@ -298,7 +299,7 @@ def nmf(X: DistMatrix, rank: int, max_iters: int = 200, tol: float = 1e-5,
         W = W.with_local(W.local * XHt.local / (WHHt.local + eps))
         R = gemm(W, H, nb=nb, precision=precision)
         err = float(frobenius_norm(X.with_local(X.local - R.local))) \
-            / max(float(frobenius_norm(X)), 1e-30)
+            / nrmX
         info.update(iters=it, rel_err=err)
         if abs(last - err) < tol * max(err, 1e-30):
             break
@@ -312,7 +313,10 @@ def sparse_inv_cov(S: DistMatrix, lam: float, rho: float = 1.0,
     """Graphical lasso: min tr(S X) - logdet X + lam ||X||_1
     (``El::SparseInvCov``, ADMM): the X-update is one Hermitian
     eigensolve (matmul-rich on TPU), the Z-update a soft-threshold.
-    Returns (X, info)."""
+    Returns (Z, info) -- Z is the SPARSE consensus iterate (the
+    soft-thresholded copy); it is symmetric but not guaranteed positive
+    definite, so take logdet/Cholesky of the problem's X-side quantity,
+    not of this return."""
     from ..lapack.spectral import herm_eig
     from ..core.dist import STAR
     from ..core.distmatrix import DistMatrix as _DM
@@ -353,7 +357,11 @@ def long_only_portfolio(Sigma: DistMatrix, mu_vec, gamma: float = 1.0,
     """Long-only risk-adjusted portfolio (``El::LongOnlyPortfolio``):
     max mu'x - gamma * sqrt(x' Sigma x)  s.t.  1'x = 1, x >= 0,
     as the SOCP min -mu'x + gamma t with ||Sigma^{1/2} x|| <= t.
-    Returns (x, info)."""
+
+    NOTE on the objective: the risk term is the STANDARD DEVIATION
+    (the SOCP-natural form per SURVEY.md §3.5's "(SOCP)" row); a
+    variance-penalized gamma from a QP formulation does not transfer
+    at the same value.  Returns (x, info)."""
     from .affine import socp_affine
     n = Sigma.gshape[0]
     g = Sigma.grid

@@ -210,3 +210,28 @@ def test_cholesky_pivoted_scaled_identity(two_grids):
     Lg = _t(L)
     p = np.asarray(perm)
     assert np.allclose(Lg @ Lg.T, F[np.ix_(p, p)], rtol=1e-10)
+
+
+def test_cholesky_mod_up_and_downdate(two_grids):
+    """Rank-k update then the inverse downdate returns the original
+    factor (El::CholeskyMod oracle)."""
+    rng = np.random.default_rng(9)
+    n, k = 22, 3
+    G0 = rng.normal(size=(n, n))
+    F = G0 @ G0.T + n * np.eye(n)
+    V = rng.normal(size=(n, k))
+    L = el.cholesky(_g(F, two_grids))
+    L2 = el.cholesky_mod(L, _g(V, two_grids), 1.5)
+    L2g = _t(L2)
+    assert np.allclose(L2g @ L2g.T, F + 1.5 * V @ V.T, atol=1e-9)
+    L3 = el.cholesky_mod(L2, _g(V, two_grids), -1.5)
+    L3g = _t(L3)
+    assert np.allclose(L3g @ L3g.T, F, atol=1e-8)
+    assert np.allclose(L3g, _t(L), atol=1e-8)
+
+
+def test_cholesky_mod_indefinite_downdate_raises(two_grids):
+    L = el.cholesky(_g(np.eye(6), two_grids))
+    V = np.zeros((6, 1)); V[0] = 2.0
+    with pytest.raises(ValueError):
+        el.cholesky_mod(L, _g(V, two_grids), -1.0)
