@@ -24,7 +24,7 @@ from .redist.engine import redistribute, transpose_dist
 
 __version__ = "0.2.0"
 
-from . import blas, lapack, matrices, optimization, control
+from . import blas, lapack, matrices, optimization, control, lattice
 from .blas import (gemm, herk, syrk, trrk, trsm, trr2k, her2k, syr2k,
                    hemm, symm, trmm, two_sided_trsm, two_sided_trmm,
                    multishift_trsm, quasi_trsm)
@@ -60,11 +60,14 @@ from .optimization import (MehrotraCtrl, lp, qp, socp, soft_threshold, svt,
                            cp, ds, en, nmf, sparse_inv_cov,
                            long_only_portfolio, tv)
 from .control import sylvester, lyapunov, riccati
+from .lattice import lll, is_lll_reduced, shortest_vector
 from .lapack.schur import schur, triang_eig, eig, pseudospectra
 from .lapack.props import (determinant, safe_determinant, hpd_determinant,
                            two_norm_estimate, condition, nuclear_norm,
                            schatten_norm, two_norm)
-from .io import print_matrix, write_matrix, read_matrix, checkpoint, restore
+from .io import (print_matrix, write_matrix, read_matrix, checkpoint,
+                 restore, write_matrix_market, read_matrix_market, display,
+                 spy)
 from . import sparse
 from .sparse import (Graph, DistGraph, SparseMatrix, DistSparseMatrix,
                      DistMap, sparse_from_coo, dist_sparse_from_coo,

@@ -2,4 +2,6 @@
 
 Reference: Elemental ``src/io/``.
 """
-from .core import print_matrix, write_matrix, read_matrix, checkpoint, restore
+from .core import (print_matrix, write_matrix, read_matrix, checkpoint,
+                   restore, write_matrix_market, read_matrix_market,
+                   display, spy)

@@ -94,3 +94,161 @@ def restore(path: str, names, grid: Grid | None = None) -> dict:
     """Reload a :func:`checkpoint` directory; returns {name: DistMatrix}."""
     return {name: read_matrix(os.path.join(path, name), grid=grid)
             for name in names}
+
+
+# ---------------------------------------------------------------------
+# Matrix Market + Display/Spy (SURVEY.md §3.5 IO row completion)
+# ---------------------------------------------------------------------
+
+def write_matrix_market(A, path: str, comment: str = "") -> None:
+    """Write to MatrixMarket format (``El::Write`` MATRIX_MARKET): dense
+    DistMatrix -> 'array' format; DistSparseMatrix -> 'coordinate'."""
+    from ..sparse.core import DistSparseMatrix
+    import numpy as np
+    if isinstance(A, DistSparseMatrix):
+        from ..sparse.core import sparse_to_coo
+        rows, cols, vals = sparse_to_coo(A)
+        m, n = A.gshape
+        cplx = np.iscomplexobj(vals)
+        field = "complex" if cplx else "real"
+        with open(path, "w") as f:
+            f.write(f"%%MatrixMarket matrix coordinate {field} general\n")
+            if comment:
+                f.write(f"% {comment}\n")
+            f.write(f"{m} {n} {len(vals)}\n")
+            for r, c, v in zip(rows, cols, vals):
+                if cplx:
+                    f.write(f"{r + 1} {c + 1} {v.real:.17g} {v.imag:.17g}\n")
+                else:
+                    f.write(f"{r + 1} {c + 1} {v:.17g}\n")
+        return
+    arr = np.asarray(to_global(A))
+    m, n = arr.shape
+    cplx = np.iscomplexobj(arr)
+    field = "complex" if cplx else "real"
+    with open(path, "w") as f:
+        f.write(f"%%MatrixMarket matrix array {field} general\n")
+        if comment:
+            f.write(f"% {comment}\n")
+        f.write(f"{m} {n}\n")
+        for j in range(n):               # column-major per the MM spec
+            for i in range(m):
+                v = arr[i, j]
+                if cplx:
+                    f.write(f"{v.real:.17g} {v.imag:.17g}\n")
+                else:
+                    f.write(f"{v:.17g}\n")
+
+
+def read_matrix_market(path: str, grid: Grid | None = None, sparse=None):
+    """Read MatrixMarket (``El::Read`` MATRIX_MARKET): 'array' ->
+    DistMatrix [MC,MR]; 'coordinate' -> DistSparseMatrix (or a dense
+    DistMatrix when ``sparse=False``).  Symmetric/hermitian/skew files
+    are expanded to general storage."""
+    import numpy as np
+    with open(path) as f:
+        header = f.readline().strip().lower().split()
+        if len(header) < 5 or header[0] != "%%matrixmarket":
+            raise ValueError(f"not a MatrixMarket file: {path}")
+        _, obj, fmt, field, symm = header[:5]
+        line = f.readline()
+        while line.startswith("%"):
+            line = f.readline()
+        dims = line.split()
+        if fmt == "coordinate":
+            m, n, nnz = int(dims[0]), int(dims[1]), int(dims[2])
+            rows = np.empty(nnz, np.int64)
+            cols = np.empty(nnz, np.int64)
+            vals = np.empty(nnz, np.complex128 if field == "complex"
+                            else np.float64)
+            for t in range(nnz):
+                parts = f.readline().split()
+                rows[t], cols[t] = int(parts[0]) - 1, int(parts[1]) - 1
+                if field == "pattern":
+                    vals[t] = 1.0
+                elif field == "complex":
+                    vals[t] = float(parts[2]) + 1j * float(parts[3])
+                else:
+                    vals[t] = float(parts[2])
+            if symm in ("symmetric", "hermitian", "skew-symmetric"):
+                off = rows != cols
+                r2, c2, v2 = cols[off], rows[off], vals[off]
+                if symm == "hermitian":
+                    v2 = np.conj(v2)
+                elif symm == "skew-symmetric":
+                    v2 = -v2
+                rows = np.concatenate([rows, r2])
+                cols = np.concatenate([cols, c2])
+                vals = np.concatenate([vals, v2])
+            from ..sparse.core import dist_sparse_from_coo
+            if sparse is False:
+                dense = np.zeros((m, n), vals.dtype)
+                np.add.at(dense, (rows, cols), vals)
+                return from_global(dense, MC, MR, grid=grid)
+            return dist_sparse_from_coo(rows, cols, vals, m, n, grid=grid)
+        m, n = int(dims[0]), int(dims[1])
+        data = np.array(f.read().split(), np.float64)
+        if field == "complex":
+            data = data[0::2] + 1j * data[1::2]
+        if symm in ("symmetric", "hermitian", "skew-symmetric"):
+            # packed lower triangle, column-major (m*(m+1)/2 values)
+            arr = np.zeros((m, n), data.dtype)
+            at = 0
+            for j in range(n):
+                cnt = m - j
+                arr[j:, j] = data[at:at + cnt]
+                at += cnt
+            up = arr.T.copy()
+            if symm == "hermitian":
+                up = up.conj()
+            elif symm == "skew-symmetric":
+                up = -up
+            arr = arr + up - np.diag(np.diag(arr))
+        else:
+            arr = data[: m * n].reshape((n, m)).T    # column-major
+        return from_global(arr, MC, MR, grid=grid)
+
+
+def display(A, title: str = "", path: str | None = None, cmap="viridis"):
+    """Heat-map dump of |A| (``El::Display``; matplotlib instead of Qt5 --
+    SURVEY.md §3.7 item 6).  Saves to ``path`` (default: <title>.png)."""
+    import numpy as np
+    from matplotlib.figure import Figure
+    arr = np.asarray(to_global(A))
+    fig = Figure(figsize=(6, 5))        # Agg canvas; no global-backend switch
+    ax = fig.add_subplot()
+    im = ax.imshow(np.abs(arr), aspect="auto", cmap=cmap,
+                   interpolation="nearest")
+    fig.colorbar(im, ax=ax)
+    ax.set_title(title or "DistMatrix")
+    out = path or f"{(title or 'matrix').replace(' ', '_')}.png"
+    fig.savefig(out, dpi=120, bbox_inches="tight")
+    return out
+
+
+def spy(A, tol: float = 0.0, title: str = "", path: str | None = None):
+    """Sparsity portrait (``El::Spy``): marks |A_ij| > tol."""
+    import numpy as np
+    from matplotlib.figure import Figure
+    from ..sparse.core import DistSparseMatrix, sparse_to_coo
+    fig = Figure(figsize=(6, 6))        # Agg canvas; no global-backend switch
+    ax = fig.add_subplot()
+    if isinstance(A, DistSparseMatrix):
+        # plot the triplets directly: O(nnz), never a dense m x n mask
+        rows, cols, vals = sparse_to_coo(A)
+        keep = np.abs(vals) > tol
+        m, n = A.gshape
+        ax.plot(cols[keep], rows[keep], ".", markersize=2)
+        ax.set_xlim(-0.5, n - 0.5)
+        ax.set_ylim(m - 0.5, -0.5)
+        ax.set_aspect("equal")
+        nnz = int(keep.sum())
+    else:
+        arr = np.asarray(to_global(A))
+        mask = np.abs(arr) > tol
+        ax.spy(mask, markersize=2)
+        nnz = int(mask.sum())
+    ax.set_title(title or f"nnz = {nnz}")
+    out = path or f"{(title or 'spy').replace(' ', '_')}.png"
+    fig.savefig(out, dpi=120, bbox_inches="tight")
+    return out

@@ -1,0 +1,2 @@
+"""Lattice tier (reference: Elemental ``src/lattice/**`` ※)."""
+from .core import lll, is_lll_reduced, shortest_vector

@@ -46,18 +46,8 @@ from .util import MehrotraCtrl
 # triplet helpers
 # ---------------------------------------------------------------------
 
-def sparse_to_coo(A: DistSparseMatrix):
-    """Host (rows, cols, vals) triplets (padding no-ops dropped)."""
-    from ..core.multivec import _blk
-    m, n = A.gshape
-    blk = _blk(m, A.grid.size)
-    rl = np.asarray(A.rows_loc)
-    p, k = rl.shape
-    rg = (rl + blk * np.arange(p)[:, None]).reshape(-1)
-    cg = np.asarray(A.cols).reshape(-1)
-    vg = np.asarray(A.vals).reshape(-1)
-    keep = vg != 0
-    return rg[keep], cg[keep], vg[keep]
+# re-exported for back-compat; the helper lives with its type now
+from ..sparse.core import sparse_to_coo  # noqa: E402,F401
 
 
 def sparse_ruiz_equil(rows, cols, vals, m, n, iters: int = 6):

@@ -7,6 +7,6 @@ factorization (METIS nested dissection) is consciously out of scope
 (SURVEY.md §3.7 item 4, §8.3 item 6); the TPU-native sparse story is
 static-shape COO kernels under ``shard_map`` + matmul-free Krylov solvers.
 """
-from .core import (Graph, DistGraph, SparseMatrix, DistSparseMatrix,
+from .core import (sparse_to_coo, Graph, DistGraph, SparseMatrix, DistSparseMatrix,
                    DistMap, sparse_from_coo, dist_sparse_from_coo)
 from .solvers import cg, cgls, gmres

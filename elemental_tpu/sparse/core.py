@@ -341,3 +341,18 @@ def dist_sparse_from_coo(rows, cols, vals, m: int, n: int,
         jax.device_put(jnp.asarray(R), sh),
         jax.device_put(jnp.asarray(C), sh),
         (m, n), nnz, grid)
+
+
+def sparse_to_coo(A: DistSparseMatrix):
+    """Host (rows, cols, vals) triplets of a DistSparseMatrix (padding
+    no-ops dropped) -- the inverse of :func:`dist_sparse_from_coo`."""
+    from ..core.multivec import _blk
+    m, n = A.gshape
+    blk = _blk(m, A.grid.size)
+    rl = np.asarray(A.rows_loc)
+    p, k = rl.shape
+    rg = (rl + blk * np.arange(p)[:, None]).reshape(-1)
+    cg = np.asarray(A.cols).reshape(-1)
+    vg = np.asarray(A.vals).reshape(-1)
+    keep = vg != 0
+    return rg[keep], cg[keep], vg[keep]

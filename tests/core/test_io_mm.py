@@ -1,0 +1,81 @@
+"""Matrix-Market + Display/Spy IO (SURVEY.md §3.5 IO row completion)."""
+import os
+import numpy as np
+import pytest
+
+import elemental_tpu as el
+
+
+def test_mm_dense_roundtrip(grid24, tmp_path):
+    rng = np.random.default_rng(0)
+    F = rng.normal(size=(9, 5))
+    A = el.from_global(F, el.MC, el.MR, grid=grid24)
+    p = str(tmp_path / "a.mtx")
+    el.write_matrix_market(A, p, comment="test")
+    B = el.read_matrix_market(p, grid=grid24)
+    assert np.allclose(np.asarray(el.to_global(B)), F)
+
+
+def test_mm_dense_complex_roundtrip(grid24, tmp_path):
+    rng = np.random.default_rng(1)
+    F = rng.normal(size=(6, 7)) + 1j * rng.normal(size=(6, 7))
+    A = el.from_global(F, el.MC, el.MR, grid=grid24)
+    p = str(tmp_path / "c.mtx")
+    el.write_matrix_market(A, p)
+    B = el.read_matrix_market(p, grid=grid24)
+    assert np.allclose(np.asarray(el.to_global(B)), F)
+
+
+def test_mm_sparse_roundtrip(grid24, tmp_path):
+    from elemental_tpu.sparse.core import dist_sparse_from_coo
+    rng = np.random.default_rng(2)
+    m, n, nnz = 20, 14, 60
+    rows = rng.integers(0, m, nnz)
+    cols = rng.integers(0, n, nnz)
+    vals = rng.normal(size=nnz)
+    A = dist_sparse_from_coo(rows, cols, vals, m, n, grid=grid24,
+                             dtype=np.float64)
+    ref = np.zeros((m, n))
+    np.add.at(ref, (rows, cols), vals)
+    p = str(tmp_path / "s.mtx")
+    el.write_matrix_market(A, p)
+    B = el.read_matrix_market(p, grid=grid24)          # sparse by default
+    Bg = np.asarray(el.to_global(B.to_dense()))
+    assert np.allclose(Bg, ref)
+    Bd = el.read_matrix_market(p, grid=grid24, sparse=False)
+    assert np.allclose(np.asarray(el.to_global(Bd)), ref)
+
+
+def test_mm_symmetric_expansion(grid24, tmp_path):
+    p = str(tmp_path / "sym.mtx")
+    with open(p, "w") as f:
+        f.write("%%MatrixMarket matrix coordinate real symmetric\n")
+        f.write("3 3 4\n1 1 2.0\n2 1 -1.0\n3 2 -1.0\n3 3 2.0\n")
+    B = el.read_matrix_market(p, grid=grid24, sparse=False)
+    Bg = np.asarray(el.to_global(B))
+    ref = np.array([[2.0, -1, 0], [-1, 0, -1], [0, -1, 2.0]])
+    assert np.allclose(Bg, ref)
+
+
+def test_display_and_spy(grid24, tmp_path):
+    rng = np.random.default_rng(3)
+    F = rng.normal(size=(12, 12)) * (rng.uniform(size=(12, 12)) < 0.2)
+    A = el.from_global(F, el.MC, el.MR, grid=grid24)
+    p1 = el.display(A, "disp", path=str(tmp_path / "d.png"))
+    p2 = el.spy(A, title="spy", path=str(tmp_path / "s.png"))
+    assert os.path.getsize(p1) > 1000
+    assert os.path.getsize(p2) > 1000
+
+
+def test_mm_symmetric_array_packed(grid24, tmp_path):
+    """'array symmetric' files store only the packed lower triangle
+    (column-major) -- the spec-conforming layout must unpack."""
+    p = str(tmp_path / "syma.mtx")
+    # lower triangle of [[2,-1,0],[-1,2,-1],[0,-1,2]] column-major:
+    # col0: 2,-1,0; col1: 2,-1; col2: 2
+    with open(p, "w") as f:
+        f.write("%%MatrixMarket matrix array real symmetric\n")
+        f.write("3 3\n2\n-1\n0\n2\n-1\n2\n")
+    B = el.read_matrix_market(p, grid=grid24)
+    ref = np.array([[2.0, -1, 0], [-1, 2, -1], [0, -1, 2]])
+    assert np.allclose(np.asarray(el.to_global(B)), ref)

@@ -1,0 +1,69 @@
+"""Lattice-reduction oracles (El::LLL tier, SURVEY.md §3.5 ※).
+
+Oracles: unimodularity of U, exact basis relation B_red = B U, the LLL
+conditions via the checker, and known short vectors.
+"""
+import numpy as np
+import pytest
+
+import elemental_tpu as el
+
+
+def _g(F, grid):
+    return el.from_global(np.asarray(F, np.float64), el.MC, el.MR, grid=grid)
+
+
+def test_lll_identities(grid24):
+    rng = np.random.default_rng(0)
+    n = 8
+    B = rng.integers(-30, 30, (n, n)).astype(np.float64)
+    while abs(np.linalg.det(B)) < 1:
+        B = rng.integers(-30, 30, (n, n)).astype(np.float64)
+    R, U, info = el.lll(_g(B, grid24))
+    Rg, Ug = np.asarray(el.to_global(R)), np.asarray(el.to_global(U))
+    assert np.allclose(Rg, B @ Ug, atol=1e-6)
+    assert abs(abs(np.linalg.det(Ug)) - 1.0) < 1e-6      # unimodular
+    assert np.allclose(Ug, np.round(Ug), atol=1e-9)      # integer
+    assert el.is_lll_reduced(R)
+    # same lattice determinant
+    assert np.isclose(abs(np.linalg.det(Rg)), abs(np.linalg.det(B)),
+                      rtol=1e-8)
+    # the first reduced vector is no longer than the shortest input column
+    assert info["first_norm"] <= np.linalg.norm(B, axis=0).min() + 1e-9
+
+
+def test_lll_knapsack_short_vector(grid24):
+    """Classic knapsack-style lattice: LLL finds the planted short vector."""
+    rng = np.random.default_rng(1)
+    n = 6
+    big = 1000
+    a = rng.integers(100, 500, n)
+    x = rng.integers(0, 2, n)
+    s = int(a @ x)
+    # lattice: columns (e_i, big*a_i) and (0, -big*s); the planted combo
+    # gives the short vector (x, 0)
+    B = np.zeros((n + 1, n + 1))
+    B[:n, :n] = np.eye(n)
+    B[n, :n] = big * a
+    B[:n, n] = 0
+    B[n, n] = -big * s
+    R, U, info = el.lll(_g(B, grid24), delta=0.99)
+    Rg = np.asarray(el.to_global(R))
+    norms = np.linalg.norm(Rg, axis=0)
+    assert norms.min() <= np.sqrt(n) + 1e-6     # found a (x,0)-class vector
+
+
+def test_lll_deep_and_svp(grid24):
+    rng = np.random.default_rng(2)
+    n = 6
+    B = rng.integers(-20, 20, (n, n)).astype(np.float64)
+    while abs(np.linalg.det(B)) < 1:
+        B = rng.integers(-20, 20, (n, n)).astype(np.float64)
+    Rd, Ud, _ = el.lll(_g(B, grid24), deep=True)
+    assert el.is_lll_reduced(Rd, delta=0.75)
+    v, nv = el.shortest_vector(_g(B, grid24))
+    # v must be a lattice vector: integer coordinates in the basis
+    coef = np.linalg.solve(B, v)
+    assert np.allclose(coef, np.round(coef), atol=1e-6)
+    R, _, info = el.lll(_g(B, grid24))
+    assert nv <= info["first_norm"] + 1e-9
