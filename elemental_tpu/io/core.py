@@ -191,17 +191,20 @@ def read_matrix_market(path: str, grid: Grid | None = None, sparse=None):
         if field == "complex":
             data = data[0::2] + 1j * data[1::2]
         if symm in ("symmetric", "hermitian", "skew-symmetric"):
-            # packed lower triangle, column-major (m*(m+1)/2 values)
+            # packed lower triangle, column-major; skew files omit the
+            # (zero) diagonal, storing only the strictly-lower part
+            skew = symm == "skew-symmetric"
             arr = np.zeros((m, n), data.dtype)
             at = 0
             for j in range(n):
-                cnt = m - j
-                arr[j:, j] = data[at:at + cnt]
+                lo = j + 1 if skew else j
+                cnt = m - lo
+                arr[lo:, j] = data[at:at + cnt]
                 at += cnt
             up = arr.T.copy()
             if symm == "hermitian":
                 up = up.conj()
-            elif symm == "skew-symmetric":
+            elif skew:
                 up = -up
             arr = arr + up - np.diag(np.diag(arr))
         else:
@@ -214,6 +217,9 @@ def display(A, title: str = "", path: str | None = None, cmap="viridis"):
     SURVEY.md §3.7 item 6).  Saves to ``path`` (default: <title>.png)."""
     import numpy as np
     from matplotlib.figure import Figure
+    from ..sparse.core import DistSparseMatrix
+    if isinstance(A, DistSparseMatrix):
+        A = A.to_dense()                # a heat map is dense by nature
     arr = np.asarray(to_global(A))
     fig = Figure(figsize=(6, 5))        # Agg canvas; no global-backend switch
     ax = fig.add_subplot()

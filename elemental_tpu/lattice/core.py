@@ -47,18 +47,18 @@ def _lll_host(B, delta: float, eta: float = 0.51, deep: bool = False,
     it = 0
     while k < n and it < max_sweeps * n:
         it += 1
-        # size-reduce column k against j = k-1 .. 0; the GSO from the
-        # final (no-change) pass is reused by the condition checks below
-        changed = True
-        while changed:
-            changed = False
-            mu, nrm2 = _gso(B)
-            for j in range(k - 1, -1, -1):
-                q = np.round(mu[k, j])
-                if abs(mu[k, j]) > eta and q != 0:
-                    B[:, k] -= q * B[:, j]
-                    U[:, k] -= q * U[:, j]
-                    changed = True
+        # one GSO per k-visit; size reduction updates row k of mu IN
+        # PLACE (B* is invariant under column-k subtractions, and the
+        # descending-j sweep leaves every |mu[k, j]| <= 1/2 exactly) --
+        # the standard bookkeeping, O(n) per j instead of a fresh
+        # O(m n^2) Gram-Schmidt per subtraction
+        mu, nrm2 = _gso(B)
+        for j in range(k - 1, -1, -1):
+            q = np.round(mu[k, j])
+            if abs(mu[k, j]) > eta and q != 0:
+                B[:, k] -= q * B[:, j]
+                U[:, k] -= q * U[:, j]
+                mu[k, : j + 1] -= q * mu[j, : j + 1]
         if deep:
             # Schnorr-Euchner deep insertion: walk c = ||pi_i(b_k)||^2
             # down the positions; insert at the first i where

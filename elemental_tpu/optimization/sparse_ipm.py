@@ -18,16 +18,18 @@ The Jacobi diagonal diag(A D^2 A^T) costs ONE SpMV of the squared-value
 matrix against d^2 per iteration.  Ruiz equilibration preprocesses the
 triplets host-side (O(nnz), once per solve).
 
-Why this maps well to TPU: the IPM spends its FLOPs in SpMV sweeps
-(bandwidth-bound shard_map kernels that scale with devices), the host
-convergence loop stays tiny, and no O(n^2) dense object is ever formed --
-"sparse LP converges at n >> dense" is the capability this buys.
-Multifrontal LDL on supernodal dense fronts remains the upgrade path.
+Why this maps well to TPU: the residual/step algebra is SpMV sweeps
+(bandwidth-bound shard_map kernels that scale with devices) and the host
+convergence loop stays tiny; no dense O(n^2) object is ever formed on
+DEVICE ('cg' forms none anywhere; 'direct' holds the host sparse factor,
+whose size is structure-dependent fill, not n^2) -- "sparse LP converges
+at n >> dense" is the capability this buys.  Distributed multifrontal
+LDL on supernodal dense fronts remains the upgrade path.
 
-Latency caveat: every CG iteration costs a few host<->device syncs (the
-alpha/beta scalars), so throughput assumes host-local dispatch; over a
-high-latency tunneled device, batch-jit the CG loop (lax.while_loop)
-before chasing wall-clock.
+Each CG solve is ONE jitted ``lax.while_loop`` device call (the eager
+host loop's ~6 dispatches + 3 blocking scalar reads per iteration
+dominate wall-clock at scale); only the Mehrotra outer loop runs on the
+host, matching the SURVEY.md §4.6 host/device split.
 """
 from __future__ import annotations
 
@@ -77,29 +79,48 @@ def _emul(X: DistMultiVec, Y: DistMultiVec) -> DistMultiVec:
     return X.with_local(X.local * Y.local)
 
 
-def _pcg(op, b: DistMultiVec, dinv: DistMultiVec, tol: float,
-         maxiter: int):
-    """Jacobi-preconditioned CG on a DistMultiVec operator."""
-    x = mv_zeros(b.gshape[0], b.gshape[1], grid=b.grid, dtype=b.dtype)
-    r = b
-    zv = _emul(dinv, r)
-    p = zv
-    rz = float(jnp.real(mv_dot(r, zv)))
-    bnorm = max(float(mv_nrm2(b)), 1e-300)
-    it = 0
-    while it < maxiter and float(mv_nrm2(r)) / bnorm >= tol:
+import jax
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("maxiter",))
+def _pcg_device(A: DistSparseMatrix, d2: DistMultiVec, reg,
+                b: DistMultiVec, dinv: DistMultiVec, tol, maxiter: int):
+    """Jacobi-preconditioned CG on the regularized normal operator
+    w -> A D^2 A' w + reg w, as ONE device call (lax.while_loop): the
+    eager host loop costs ~6 dispatches + 3 blocking scalar reads per
+    iteration, which dominates wall-clock at scale (and is hopeless on
+    high-latency tunneled backends)."""
+
+    def op(w):
+        t = A.spmv_adjoint(w)
+        return mv_axpy(reg, w, A.spmv(_emul(d2, t)))
+
+    x0 = b.with_local(jnp.zeros_like(b.local))
+    z0 = _emul(dinv, b)
+    rz0 = jnp.real(mv_dot(b, z0))
+    bnorm = jnp.maximum(mv_nrm2(b), 1e-300)
+
+    def cond(state):
+        x, r, p, rz, it, ok = state
+        return ok & (it < maxiter) & (mv_nrm2(r) / bnorm >= tol)
+
+    def body(state):
+        x, r, p, rz, it, ok = state
         Ap = op(p)
-        denom = float(jnp.real(mv_dot(p, Ap)))
-        if denom <= 0:
-            break                       # loss of positive-definiteness
-        alpha = rz / denom
+        denom = jnp.real(mv_dot(p, Ap))
+        pd = denom > 0
+        alpha = jnp.where(pd, rz / jnp.where(pd, denom, 1.0), 0.0)
         x = mv_axpy(alpha, p, x)
         r = mv_axpy(-alpha, Ap, r)
         zv = _emul(dinv, r)
-        rz_new = float(jnp.real(mv_dot(r, zv)))
-        p = mv_axpy(rz_new / rz, p, zv)
-        rz = rz_new
-        it += 1
+        rz_new = jnp.real(mv_dot(r, zv))
+        beta = rz_new / jnp.where(rz != 0, rz, 1.0)
+        p = mv_axpy(beta, p, zv)
+        return x, r, p, rz_new, it + 1, pd
+
+    x, r, p, rz, it, ok = jax.lax.while_loop(
+        cond, body, (x0, b, z0, rz0, jnp.asarray(0), jnp.asarray(True)))
     return x, it
 
 
@@ -109,12 +130,31 @@ def _pcg(op, b: DistMultiVec, dinv: DistMultiVec, tol: float,
 
 def lp_sparse(A: DistSparseMatrix, b: DistMultiVec, c: DistMultiVec,
               ctrl: MehrotraCtrl | None = None, cg_tol: float = 1e-10,
-              cg_maxiter: int | None = None, refine: int = 1):
+              cg_maxiter: int | None = None, refine: int = 1,
+              kkt: str = "auto"):
     """Standard-form LP over a DistSparseMatrix: min c'x st Ax=b, x >= 0.
 
-    Returns (x, y, z, info) as DistMultiVecs.  The KKT solves are
-    matrix-free regularized CG with ``refine`` rounds of iterative
-    refinement (the ``reg_ldl`` role -- see module docstring)."""
+    Returns (x, y, z, info) as DistMultiVecs.  ``kkt`` picks the normal-
+    equation engine (the ``reg_ldl`` role -- see module docstring):
+
+      * 'direct' -- SEQUENTIAL sparse-direct factorization of
+        A D^2 A' + reg (scipy splu on host triplets, refactored per
+        iteration).  The analog of the reference's sequential sparse
+        path (``El::SparseMatrix`` + ``ldl``); robust at high
+        conditioning, where Krylov iteration counts blow up as
+        ~1/sqrt(mu).  The distributed-multifrontal numeric factor is
+        the upgrade path.
+      * 'cg' -- matrix-free Jacobi-preconditioned CG (fully
+        distributed, no host factorization; fine at moderate
+        accuracy/conditioning).
+      * 'auto' -- 'direct' when scipy is importable and m is moderate
+        (robustness first, as upstream always factors); 'cg' otherwise.
+        NOTE the trade: 'direct' gathers triplets to the host and its
+        fill depends on structure (banded/separator graphs are cheap;
+        random-expander patterns fill catastrophically -- for those,
+        neither engine is good, which is the fundamental reason the
+        reference bundles ParMETIS orderings).
+    """
     ctrl = ctrl or MehrotraCtrl()
     m, n = A.gshape
     g = A.grid
@@ -122,6 +162,12 @@ def lp_sparse(A: DistSparseMatrix, b: DistMultiVec, c: DistMultiVec,
         raise ValueError(f"shape mismatch: A {A.gshape}, b {b.gshape}, "
                          f"c {c.gshape}")
     cg_maxiter = cg_maxiter or 4 * m
+    if kkt == "auto":
+        try:
+            import scipy.sparse  # noqa: F401
+            kkt = "direct" if m <= 200_000 else "cg"
+        except ImportError:
+            kkt = "cg"
 
     d_r = np.ones(m)
     d_c = np.ones(n)
@@ -140,35 +186,65 @@ def lp_sparse(A: DistSparseMatrix, b: DistMultiVec, c: DistMultiVec,
     A2 = A.with_values(A.vals * A.vals)          # |A|^2 for Jacobi diagonals
     vm_x = _valid(n, c)                          # row-validity masks
     vm_y = _valid(m, b)
+    if kkt == "direct":
+        import scipy.sparse as _sp
+        ro2, co2, vo2 = sparse_to_coo(A)
+        _Acsr = _sp.csr_matrix((np.asarray(vo2, np.float64),
+                                (ro2, co2)), shape=(m, n))
 
     def esafe(xl, zl):
         return jnp.where(zl != 0, xl / jnp.where(zl == 0, 1, zl), 0)
 
-    def jacobi_data(d2: DistMultiVec):
-        """(reg, dinv) for the current D^2 -- computed ONCE per IPM
-        iteration (normal_solve is called 4x per iteration on the same
-        D^2: hoisting saves 3 SpMV sweeps + 3 host syncs each round)."""
+    def engine_data(d2: DistMultiVec):
+        """Per-IPM-iteration solver data (normal_solve runs 4x on the
+        same D^2: predictor + corrector, each with a refinement pass).
+
+        'direct': assemble A E A' + reg on host triplets and splu-factor
+        (the reg_ldl refactor step).  'cg': Jacobi diagonal + reg."""
+        if kkt == "direct":
+            import scipy.sparse.linalg as _spl
+            e = np.asarray(mv_to_global(d2)).ravel()
+            M = (_Acsr.multiply(e[None, :])) @ _Acsr.T
+            reg = 1e-10 * (1.0 + float(abs(M.diagonal()).max()))
+            M = (M + reg * _sp.eye(m, format="csr")).tocsc()
+            return reg, _spl.splu(M)
         diag = A2.spmv(d2)
         reg = 1e-10 * (1.0 + float(jnp.max(diag.local)))
         diag = diag.with_local(diag.local + reg * vm_y[:, None])
         return reg, diag.with_local(esafe(vm_y[:, None], diag.local))
 
     def normal_solve(d2: DistMultiVec, rhs: DistMultiVec, tol, jd=None):
-        """(A D2 A' + reg) w = rhs by Jacobi-CG + iterative refinement."""
-        reg, dinv = jd if jd is not None else jacobi_data(d2)
+        """(A D2 A' + reg) w = rhs by the selected engine + iterative
+        refinement against the true (device-side) operator."""
+        reg, fac = jd if jd is not None else engine_data(d2)
 
         def op(w):
             t = A.spmv_adjoint(w)
             return mv_axpy(reg, w, A.spmv(_emul(d2, t)))
 
-        w, it = _pcg(op, rhs, dinv, tol, cg_maxiter)
+        if kkt == "direct":
+            rh = np.asarray(mv_to_global(rhs)).ravel()
+            w = mv_from_global(fac.solve(rh).reshape(-1, 1), grid=g)
+            it = 1                      # factor-solve counts as one pass
+            for _ in range(refine):
+                r = mv_axpy(-1.0, op(w), rhs)
+                if float(mv_nrm2(r)) / max(float(mv_nrm2(rhs)),
+                                           1e-300) < tol:
+                    break
+                dr = np.asarray(mv_to_global(r)).ravel()
+                w = mv_axpy(1.0, mv_from_global(
+                    fac.solve(dr).reshape(-1, 1), grid=g), w)
+                it += 1
+            return w, it
+        w, it = _pcg_device(A, d2, reg, rhs, fac, tol, cg_maxiter)
+        it = int(it)
         for _ in range(refine):
             r = mv_axpy(-1.0, op(w), rhs)
             if float(mv_nrm2(r)) / max(float(mv_nrm2(rhs)), 1e-300) < tol:
                 break
-            dw, it2 = _pcg(op, r, dinv, tol, cg_maxiter)
+            dw, it2 = _pcg_device(A, d2, reg, r, fac, tol, cg_maxiter)
             w = mv_axpy(1.0, dw, w)
-            it += it2
+            it += int(it2)
         return w, it
 
     # ---- Mehrotra initialization (least-norm via A A') ----------------
@@ -241,7 +317,7 @@ def lp_sparse(A: DistSparseMatrix, b: DistMultiVec, c: DistMultiVec,
             break
 
         d2 = x.with_local(esafe(x.local, z.local))
-        jd_it = jacobi_data(d2)
+        jd_it = engine_data(d2)
         # inexact-Newton forcing: solve the normal system just accurately
         # enough for the current mu (tightens as the iterates converge)
         tol_it = max(cg_tol, min(1e-6, 1e-2 * mu))

@@ -79,3 +79,14 @@ def test_mm_symmetric_array_packed(grid24, tmp_path):
     B = el.read_matrix_market(p, grid=grid24)
     ref = np.array([[2.0, -1, 0], [-1, 2, -1], [0, -1, 2]])
     assert np.allclose(np.asarray(el.to_global(B)), ref)
+
+
+def test_mm_skew_symmetric_array_packed(grid24, tmp_path):
+    """'array skew-symmetric' stores only the strictly-lower triangle."""
+    p = str(tmp_path / "skew.mtx")
+    with open(p, "w") as f:
+        f.write("%%MatrixMarket matrix array real skew-symmetric\n")
+        f.write("3 3\n2\n3\n4\n")
+    B = el.read_matrix_market(p, grid=grid24)
+    ref = np.array([[0.0, -2, -3], [2, 0, -4], [3, 4, 0]])
+    assert np.allclose(np.asarray(el.to_global(B)), ref)
