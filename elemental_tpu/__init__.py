@@ -12,7 +12,8 @@ from .core.environment import (blocksize, set_blocksize, push_blocksize,
 from .core.ctrl import (SignCtrl, PolarCtrl, HermitianEigCtrl, SVDCtrl,
                         SchurCtrl, PseudospecCtrl, LDLPivotCtrl, QRCtrl,
                         LeastSquaresCtrl)
-from .core.distmatrix import DistMatrix, from_global, to_global, zeros
+from .core.distmatrix import (DistMatrix, from_global, to_global,
+                              zeros, remote_updates)
 from .core.block import (BlockMatrix, block_from_global, block_from_array,
                          block_to_global, block_to_cyclic, block_from_cyclic,
                          as_elemental)
@@ -71,4 +72,4 @@ from .io import (print_matrix, write_matrix, read_matrix, checkpoint,
 from . import sparse
 from .sparse import (Graph, DistGraph, SparseMatrix, DistSparseMatrix,
                      DistMap, sparse_from_coo, dist_sparse_from_coo,
-                     cg, cgls, gmres)
+                     cg, cgls, gmres, sparse_direct_solve)

@@ -216,3 +216,29 @@ def zeros(m: int, n: int, cdist: Dist = Dist.MC, rdist: Dist = Dist.MR,
     dm = DistMatrix(None, (m, n), cdist, rdist, calign, ralign, grid)
     stor = jnp.zeros((qc * lr, qr_ * lc), dtype)
     return dm.with_local(jax.device_put(stor, grid.sharding(dm.spec)))
+
+
+def remote_updates(A: DistMatrix, rows, cols, vals) -> DistMatrix:
+    """Batched global updates ``A[rows[k], cols[k]] += vals[k]`` -- the
+    ``AxpyInterface`` / ``Reserve+QueueUpdate+ProcessQueues`` analog for
+    DistMatrix (upstream ``include/El/core/AxpyInterface.hpp``): callers
+    queue arbitrary (possibly duplicate) global updates; one scatter-add
+    on the storage array lands them, XLA routing the cross-device writes
+    (the nonblocking two-sided exchange the reference does by hand).
+
+    Indices are validated host-side when concrete; cyclic layouts only
+    (MD/CIRC route through a redistribution first)."""
+    from .multivec import _validate_update_indices
+    if Dist.MD in A.dist or Dist.CIRC in A.dist:
+        raise ValueError("remote_updates supports cyclic layouts; "
+                         "redistribute MD/CIRC operands first")
+    m, n = A.gshape
+    _validate_update_indices(rows, cols, m, n, A.gshape)
+    i = jnp.asarray(rows)
+    j = jnp.asarray(cols)
+    vals = jnp.asarray(vals, A.dtype)
+    sc, sr = A.col_stride, A.row_stride
+    lr, lc = A.local_rows, A.local_cols
+    si = ((i + A.calign) % sc) * lr + i // sc
+    sj = ((j + A.ralign) % sr) * lc + j // sr
+    return A.with_local(A.local.at[si, sj].add(vals))

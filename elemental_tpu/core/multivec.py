@@ -130,26 +130,30 @@ def _check_same(X: DistMultiVec, Y: DistMultiVec):
 
 # ---- batched remote updates (Reserve/QueueUpdate/ProcessQueues) ------
 
+def _validate_update_indices(rows, cols, m: int, n: int, gshape) -> None:
+    """Host-side bounds check for queued remote updates (skipped for
+    traced indices, where the caller guarantees bounds; writes into the
+    zero-padding tail would corrupt padding-oblivious reductions)."""
+    import numpy as _np
+    try:
+        ri = _np.asarray(rows)
+        ci = _np.asarray(cols)
+    except Exception:
+        return                      # traced: caller guarantees bounds
+    if ri.size and (ri.min() < 0 or ri.max() >= m
+                    or ci.min() < 0 or ci.max() >= n):
+        raise ValueError(f"remote update out of bounds for gshape {gshape}")
+
+
 def mv_remote_updates(v: DistMultiVec, rows, cols, vals) -> DistMultiVec:
     """Apply a batch of ``v[rows[k], cols[k]] += vals[k]`` updates.
 
     The analog of the reference's queued ``RemoteUpdate`` +
     ``ProcessQueues``: callers batch arbitrary (possibly duplicate) global
     updates; one scatter-add lands them, XLA routing the cross-device
-    writes (the all-to-all the reference does by hand).  Indices are
-    validated host-side when concrete (the queue API is a host-side build
-    phase; writes into the zero-padding tail would corrupt every
-    padding-oblivious reduction)."""
-    import numpy as _np
+    writes (the all-to-all the reference does by hand)."""
     m, w = v.gshape
-    try:
-        ri = _np.asarray(rows)
-        ci = _np.asarray(cols)
-    except Exception:
-        ri = ci = None              # traced: caller guarantees bounds
-    if ri is not None and ri.size and (
-            ri.min() < 0 or ri.max() >= m or ci.min() < 0 or ci.max() >= w):
-        raise ValueError(f"remote update out of bounds for gshape {v.gshape}")
+    _validate_update_indices(rows, cols, m, w, v.gshape)
     rows = jnp.asarray(rows)
     cols = jnp.asarray(cols)
     vals = jnp.asarray(vals, v.dtype)

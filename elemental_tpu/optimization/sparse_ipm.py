@@ -162,6 +162,8 @@ def lp_sparse(A: DistSparseMatrix, b: DistMultiVec, c: DistMultiVec,
         raise ValueError(f"shape mismatch: A {A.gshape}, b {b.gshape}, "
                          f"c {c.gshape}")
     cg_maxiter = cg_maxiter or 4 * m
+    if kkt not in ("auto", "direct", "cg"):
+        raise ValueError(f"kkt must be 'auto', 'direct' or 'cg', got {kkt!r}")
     if kkt == "auto":
         try:
             import scipy.sparse  # noqa: F401
@@ -249,10 +251,11 @@ def lp_sparse(A: DistSparseMatrix, b: DistMultiVec, c: DistMultiVec,
 
     # ---- Mehrotra initialization (least-norm via A A') ----------------
     ones = c.with_local(vm_x[:, None].astype(c.dtype))
-    w0, _ = normal_solve(ones, b, cg_tol)
+    jd0 = engine_data(ones)          # one factorization for both solves
+    w0, _ = normal_solve(ones, b, cg_tol, jd=jd0)
     x = A.spmv_adjoint(w0)
     yrhs = A.spmv(c)
-    y, _ = normal_solve(ones, yrhs, cg_tol)
+    y, _ = normal_solve(ones, yrhs, cg_tol, jd=jd0)
     z = c.with_local(c.local - A.spmv_adjoint(y).local)
     xl, zl = x.local, z.local
     dx = max(0.0, -1.5 * float(jnp.min(jnp.where(vm_x[:, None] > 0, xl,

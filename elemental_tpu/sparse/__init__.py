@@ -9,4 +9,4 @@ static-shape COO kernels under ``shard_map`` + matmul-free Krylov solvers.
 """
 from .core import (sparse_to_coo, Graph, DistGraph, SparseMatrix, DistSparseMatrix,
                    DistMap, sparse_from_coo, dist_sparse_from_coo)
-from .solvers import cg, cgls, gmres
+from .solvers import cg, cgls, gmres, sparse_direct_solve

@@ -155,3 +155,43 @@ def gmres(A: DistSparseMatrix, b: DistMultiVec,
     relres = float(mv_nrm2(r)) / bnorm
     return x, {"converged": relres < tol, "iters": total_it,
                "relres": relres}
+
+
+def sparse_direct_solve(A: DistSparseMatrix, b: DistMultiVec,
+                        refine: int = 2, tol: float = 1e-12):
+    """Sequential sparse-direct solve A x = b (square A) -- the
+    ``El::SparseMatrix`` + ``ldl``/``LinearSolve`` sequential sparse path:
+    one host splu factorization (SuperLU: the role the reference's
+    bundled sequential multifrontal plays) + device-side SpMV iterative
+    refinement, mirroring ``reg_ldl::RegularizedSolveAfter``'s
+    factor-then-refine shape.  Returns (x, info).
+
+    For the fully-distributed-solver path use :func:`cg`/:func:`gmres`;
+    the distributed multifrontal numeric factorization is the upgrade
+    path (SURVEY.md §3.4 sparse-direct row)."""
+    import numpy as np
+    import scipy.sparse as sp
+    import scipy.sparse.linalg as spl
+    from ..core.multivec import mv_from_global, mv_to_global
+    from .core import sparse_to_coo
+    _check(A, b, square=True)
+    m, n = A.gshape
+    ro, co, vo = sparse_to_coo(A)
+    vo = np.asarray(vo)
+    dt = np.complex128 if np.iscomplexobj(vo) else np.float64
+    M = sp.csc_matrix((vo.astype(dt), (ro, co)), shape=(m, n))
+    lu = spl.splu(M)
+    bh = np.asarray(mv_to_global(b))
+    x = mv_from_global(lu.solve(bh), grid=b.grid)
+    bnorm = max(float(mv_nrm2(b)), 1e-300)
+    relres = np.inf
+    for _ in range(refine):
+        r = mv_axpy(-1.0, A.spmv(x), b)        # device-side true residual
+        relres = float(mv_nrm2(r)) / bnorm
+        if relres < tol:
+            break
+        rh = np.asarray(mv_to_global(r))
+        x = mv_axpy(1.0, mv_from_global(lu.solve(rh), grid=b.grid), x)
+    r = mv_axpy(-1.0, A.spmv(x), b)
+    relres = float(mv_nrm2(r)) / bnorm
+    return x, {"relres": relres, "converged": relres < max(tol, 1e-10)}

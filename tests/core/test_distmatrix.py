@@ -44,3 +44,45 @@ def test_local_blocks_are_cyclic_slices(grid24):
             loc = F[pr::r, pc::c]
             want[: loc.shape[0], : loc.shape[1]] = loc
             np.testing.assert_array_equal(tile, want)
+
+
+class TestRemoteUpdates:
+    """AxpyInterface analog on DistMatrix (SURVEY §3.2 row 16)."""
+
+    def test_batched_updates(self, any_grid):
+        import elemental_tpu as el
+        from elemental_tpu.core.distmatrix import remote_updates
+        rng = np.random.default_rng(0)
+        m, n = 13, 9
+        F = rng.normal(size=(m, n))
+        A = el.from_global(F, el.MC, el.MR, grid=any_grid)
+        k = 40
+        rows = rng.integers(0, m, k)
+        cols = rng.integers(0, n, k)
+        vals = rng.normal(size=k)
+        B = remote_updates(A, rows, cols, vals)
+        ref = F.copy()
+        np.add.at(ref, (rows, cols), vals)      # duplicates accumulate
+        assert np.allclose(np.asarray(to_global(B)), ref)
+
+    def test_out_of_bounds_raises(self, any_grid):
+        import elemental_tpu as el
+        from elemental_tpu.core.distmatrix import remote_updates
+        A = el.from_global(np.zeros((4, 4)), el.MC, el.MR, grid=any_grid)
+        with pytest.raises(ValueError):
+            remote_updates(A, [4], [0], [1.0])
+
+    def test_vc_star_layout(self, any_grid):
+        import elemental_tpu as el
+        from elemental_tpu.core.distmatrix import remote_updates
+        rng = np.random.default_rng(1)
+        m, n = 17, 3
+        F = rng.normal(size=(m, n))
+        A = el.from_global(F, el.VC, el.STAR, grid=any_grid)
+        rows = np.array([0, 16, 5, 5])
+        cols = np.array([0, 2, 1, 1])
+        vals = np.array([1.0, -2.0, 0.5, 0.5])
+        B = remote_updates(A, rows, cols, vals)
+        ref = F.copy()
+        np.add.at(ref, (rows, cols), vals)
+        assert np.allclose(np.asarray(to_global(B)), ref)
