@@ -370,11 +370,24 @@ def _trsm_left(uplo: str, trans: bool, conj: bool, A: DistMatrix, B: DistMatrix,
             a_loc = A1p.local
         if conj:
             a_loc = jnp.conj(a_loc)
-        upd = jnp.matmul(a_loc, X1_mr.local, precision=precision)
-        rest = view(X, rows=(lo, hi))
-        X = update_view(X, rest.with_local(rest.local - upd.astype(X.dtype)),
-                        rows=(lo, hi))
+        X = local_rank_update(X, a_loc, X1_mr.local, rows=(lo, hi),
+                              precision=precision)
     return X
+
+
+def local_rank_update(C: DistMatrix, A_loc, B_loc, rows=None, cols=None,
+                      alpha=-1.0, precision=None) -> DistMatrix:
+    """C[rows, cols] += alpha * A_loc @ B_loc on storage, pure-local.
+
+    ``A_loc`` / ``B_loc`` are the STORAGE arrays of conforming [MC,STAR]
+    and [STAR,MR] operands (rows/cols of the product land exactly on the
+    view's cyclic layout), so the whole rank-k update is one local MXU
+    matmul + writeback -- the reference's ``LocalGemm`` trailing-update
+    idiom shared by trsm, quasi_trsm and the LU/look-ahead drivers."""
+    sub = view(C, rows=rows, cols=cols)
+    upd = jnp.matmul(A_loc, B_loc, precision=precision)
+    new = sub.local + (alpha * upd).astype(C.dtype)
+    return update_view(C, sub.with_local(new), rows=rows, cols=cols)
 
 
 def quasi_trsm(side: str, orient: str, A: DistMatrix, B: DistMatrix,
@@ -449,10 +462,8 @@ def _quasi_trsm_left(trans: bool, conj: bool, A: DistMatrix, B: DistMatrix,
             a_loc = A1p.local
         if conj:
             a_loc = jnp.conj(a_loc)
-        upd = jnp.matmul(a_loc, X1_mr.local, precision=precision)
-        rest = view(X, rows=(lo, hi))
-        X = update_view(X, rest.with_local(rest.local - upd.astype(X.dtype)),
-                        rows=(lo, hi))
+        X = local_rank_update(X, a_loc, X1_mr.local, rows=(lo, hi),
+                              precision=precision)
     return X
 
 

@@ -1,4 +1,5 @@
-"""LU with partial pivoting (HPL-style) + permutation utilities.
+"""LU with partial pivoting (HPL-style, look-ahead pipelined) + permutation
+utilities.
 
 Reference: Elemental ``src/lapack_like/factor/LU.cpp`` +
 ``LU/{Panel,SolveAfter}.hpp`` and ``src/lapack_like/perm/`` (DistPermutation,
@@ -12,6 +13,48 @@ a local ``lax.fori_loop``: identical deterministic results everywhere, so
 pivot search costs zero communication.  The panel's composed row
 permutation is applied to the trailing rows with one traced gather/scatter
 on the storage array (the analog of HPL's row-broadcast swap).
+
+Look-ahead schedule (the HPL pipeline; default on)
+--------------------------------------------------
+The classic right-looking driver serializes panel -> swap -> solve ->
+update every step, so the latency-bound replicated panel factorization
+sits on the critical path ``n/nb`` times.  The pipelined driver instead
+splits step k's trailing update by columns into (a) the NEXT panel's
+strip and (b) the wide remainder:
+
+    swap + write back panel k                    (from the carried factor)
+    U_k  := L11^{-1} A(k, k+1:)                  (one row-block solve)
+    strip := A22[:, :nb] - L21 U_k[:, :nb]       (a: narrow update)
+    factor panel k+1 from ``strip``              (off the critical path)
+    rest := A22[:, nb:] - L21 U_k[:, nb:]        (b: wide MXU update)
+
+The strip/rest operands are captured BEFORE any writeback, so the panel
+k+1 factorization and the wide remainder matmul share no data dependence
+and XLA is free to overlap them (async collectives on a grid, scheduler
+freedom on one chip).  Everything stays one traced program per
+(shape, grid): no host sync between phases.
+
+Precision split (``update_precision``)
+--------------------------------------
+``precision`` governs the panel factorization and the triangular/row-block
+solves (default f32 accumulation via :func:`_hi`).  ``update_precision``,
+when given, applies ONLY to the trailing ``L21 @ U12`` updates -- passing
+``lax.Precision.DEFAULT`` runs them on the bf16 MXU path (~6x the f32-class
+matmul rate on TPU).  This is opt-in: bf16 trailing updates raise the
+``||P A - L U|| / ||A||`` residual from ~1e-6 to the ~1e-3 level at
+n=16384 (each entry of the Schur complement accumulates bf16 rounding
+``n/nb`` times), which is still small relative to partial pivoting's
+growth bound but well above the f32 default.  Leave it ``None`` for
+bit-equivalent-to-classic factors.
+
+Phase timing (``timer``)
+------------------------
+Pass a ``perf.phase_timer.PhaseTimer``-shaped object (``start()`` +
+``tick(phase, step, *arrays)``) and call ``lu`` EAGERLY (outside jit): the
+driver synchronizes at every panel / swap / solve / update boundary and the
+timer attributes per-step wall-clock.  ``python perf/ab_harness.py phases``
+emits the resulting JSON.  With ``timer=None`` (default) the hooks are
+dead code and the driver jits as one fused program.
 
 Data-dependent pivots are traced values, so the whole factorization jits;
 the packed L\\U layout and the permutation-vector convention follow LAPACK
@@ -28,12 +71,14 @@ from ..core.dist import MC, MR, STAR, VC, VR
 from ..core.distmatrix import DistMatrix
 from ..core.view import view, update_view
 from ..redist.engine import redistribute
-from ..blas.level3 import _blocksize, _check_mcmr, trsm
+from ..blas.level3 import _blocksize, _check_mcmr, local_rank_update, trsm
 
 #: chunk-width ladder for the replicated panel factorization.  A/B-measured
 #: on v5e at n=16384 nb=2048 (perf/ab_harness.py, same-process roofline
 #: brackets): (512,64) 8.18/7.34 TFLOP/s across two runs vs (256,32) 6.53,
 #: (256,64) 6.89, (1024,128) 6.92, (512,64,16) 4.89, (768,96) 7.46.
+#: ``perf/ab_harness.py lu`` sweeps this ladder against the look-ahead
+#: schedule; re-pin here when a sweep on the target chip says otherwise.
 _INNERS = (512, 64)
 
 
@@ -46,6 +91,20 @@ def _hi(precision):
     explicitly passed precision (including ``lax.Precision.DEFAULT`` for
     bf16-MXU throughput on the trailing updates) is honored unchanged."""
     return precision if precision is not None else lax.Precision.HIGHEST
+
+
+class _NullTimer:
+    """Zero-overhead stand-in so the drivers can call tick() unconditionally."""
+    __slots__ = ()
+
+    def start(self):
+        pass
+
+    def tick(self, phase, step, *arrays):
+        pass
+
+
+_NULL_TIMER = _NullTimer()
 
 
 # ---------------------------------------------------------------------
@@ -209,66 +268,131 @@ def _moved_rows(pperm, nbw: int):
 
 
 # ---------------------------------------------------------------------
-# blocked right-looking LU
+# blocked right-looking LU with look-ahead
 # ---------------------------------------------------------------------
 
-def _local_lu(A: DistMatrix, nb: int | None, precision):
+def _local_lu(A: DistMatrix, nb: int | None, precision,
+              update_precision=None, lookahead: bool = True, timer=None):
     """Sequential (p == 1) path: on a 1x1 grid the storage array IS the
     global matrix, so the blocked loop fuses into one XLA program with no
     redistribute sub-computation boundaries (the local ``Matrix<T>``
-    dispatch of the reference)."""
+    dispatch of the reference).  ``lookahead=True`` runs the pipelined
+    schedule from the module docstring; ``False`` keeps the classic
+    right-looking order (the A/B baseline)."""
     a = A.local
     m, n = A.gshape
     ib = max(nb or 1024, 1)
     kend = min(m, n)
     perm = jnp.arange(m)
-    for s in range(0, kend, ib):
+    upd = precision if update_precision is None else update_precision
+    tm = timer if timer is not None else _NULL_TIMER
+    tm.start()
+    if lookahead:
+        w0 = min(ib, kend)
+        nxt = _panel_lu(a[:, :w0], w0, precision)
+        tm.tick("panel", 0, nxt)
+    for k, s in enumerate(range(0, kend, ib)):
         e = min(s + ib, kend)
         nbw = e - s
-        Pf, pperm = _panel_lu(a[s:, s:e], nbw, precision)
+        if lookahead:
+            Pf, pperm = nxt
+        else:
+            Pf, pperm = _panel_lu(a[s:, s:e], nbw, precision)
+            tm.tick("panel", k, Pf, pperm)
         perm = perm.at[s:].set(jnp.take(perm[s:], pperm, axis=0))
         # full trailing-block gather + contiguous writeback (TPU scatters
         # of dynamic row sets benchmark SLOWER than this full gather)
         a = a.at[s:].set(jnp.take(a[s:], pperm, axis=0))
+        tm.tick("swap", k, a)
         a = a.at[s:, s:e].set(Pf)
-        if e < n:
-            Li11 = _unit_lower_inv(jnp.tril(Pf[:nbw], -1)
-                                   + jnp.eye(nbw, dtype=a.dtype),
-                                   nbw, precision)
-            U1n = jnp.matmul(Li11, a[s:e, e:], precision=_hi(precision)
-                             ).astype(a.dtype)
+        if e >= n:
+            continue
+        Li11 = _unit_lower_inv(jnp.tril(Pf[:nbw], -1)
+                               + jnp.eye(nbw, dtype=a.dtype),
+                               nbw, precision)
+        U1n = jnp.matmul(Li11, a[s:e, e:], precision=_hi(precision)
+                         ).astype(a.dtype)
+        tm.tick("solve", k, U1n)
+        if not lookahead or e >= kend:
             a = a.at[s:e, e:].set(U1n)
             if e < m:
-                upd = jnp.matmul(Pf[nbw:], U1n, precision=precision)
-                a = a.at[e:, e:].set(a[e:, e:] - upd.astype(a.dtype))
+                u = jnp.matmul(Pf[nbw:], U1n, precision=upd)
+                a = a.at[e:, e:].set(a[e:, e:] - u.astype(a.dtype))
+                tm.tick("update", k, a)
+            continue
+        # look-ahead: (a) narrow strip update -> factor panel k+1 off the
+        # critical path -> (b) wide remainder update.  Both updates read
+        # the pre-writeback ``a``, so XLA sees them as independent.
+        e2 = min(e + ib, kend)
+        w = e2 - e
+        L21 = Pf[nbw:]
+        strip = a[e:, e:e2] - jnp.matmul(L21, U1n[:, :w],
+                                         precision=upd).astype(a.dtype)
+        nxt = _panel_lu(strip, w, precision)
+        tm.tick("panel", k + 1, nxt)
+        a = a.at[s:e, e:].set(U1n)
+        if e2 < n:
+            rest = a[e:, e2:] - jnp.matmul(L21, U1n[:, w:],
+                                           precision=upd).astype(a.dtype)
+            a = a.at[e:, e2:].set(rest)
+        # the strip region a[e:, e:e2] is dead from here on: step k+1's
+        # swap + panel writeback fully overwrite it, so skipping its
+        # writeback saves one (m-e) x nb store per step
+        tm.tick("update", k, a)
     return A.with_local(a), perm
 
 
-def lu(A: DistMatrix, nb: int | None = None, precision=None):
-    """Blocked right-looking LU with partial pivoting.
+def lu(A: DistMatrix, nb: int | None = None, precision=None,
+       update_precision=None, lookahead: bool = True, timer=None):
+    """Blocked right-looking LU with partial pivoting and look-ahead.
 
     Returns (LU, perm): LU holds unit-lower L below the diagonal and U on
     and above it (LAPACK getrf packing); perm is a traced length-m vector
     with perm[i] = original index of the row now at position i, so
-    ``P A = L U`` with ``(P A)[i] = A[perm[i]]``."""
+    ``P A = L U`` with ``(P A)[i] = A[perm[i]]``.
+
+    ``lookahead`` selects the pipelined schedule (module docstring);
+    ``update_precision`` optionally lowers ONLY the trailing ``L21 @ U12``
+    updates (e.g. ``lax.Precision.DEFAULT`` for bf16-MXU throughput at a
+    documented ~1e-3 residual cost); ``timer`` enables eager per-phase
+    wall-clock attribution (see ``perf/phase_timer.py``)."""
     _check_mcmr(A)
     m, n = A.gshape
     g = A.grid
     if g.size == 1:
-        return _local_lu(A, nb, precision)
+        return _local_lu(A, nb, precision, update_precision, lookahead, timer)
     r, c = g.height, g.width
     ib = _blocksize(nb, math.lcm(r, c), min(m, n))
     kend = min(m, n)
     perm = jnp.arange(m)
-    for s in range(0, kend, ib):
-        e = min(s + ib, kend)
-        nbw = e - s
+    upd = precision if update_precision is None else update_precision
+    tm = timer if timer is not None else _NULL_TIMER
+    tm.start()
+
+    def col_up(e):
         # Views must start/end on stride boundaries; a ragged diagonal end
         # (wide matrices, e == m not stride-aligned) is handled by widening
         # every view to a legal boundary and column-masking the writebacks.
-        e_up = min(-(-e // c) * c, n)
-        panel = redistribute(view(A, rows=(s, m), cols=(s, e_up)), STAR, STAR)
-        Pf, pperm = _panel_lu(panel.local[:, :nbw], nbw, precision)
+        return min(-(-e // c) * c, n)
+
+    if lookahead:
+        e0_up = col_up(min(ib, kend))
+        panel0 = redistribute(view(A, rows=(0, m), cols=(0, e0_up)),
+                              STAR, STAR)
+        nxt = _panel_lu(panel0.local[:, :min(ib, kend)], min(ib, kend),
+                        precision)
+        tm.tick("panel", 0, nxt)
+    for k, s in enumerate(range(0, kend, ib)):
+        e = min(s + ib, kend)
+        nbw = e - s
+        e_up = col_up(e)
+        if lookahead:
+            Pf, pperm = nxt
+        else:
+            panel = redistribute(view(A, rows=(s, m), cols=(s, e_up)),
+                                 STAR, STAR)
+            Pf, pperm = _panel_lu(panel.local[:, :nbw], nbw, precision)
+            tm.tick("panel", k, Pf, pperm)
         perm = perm.at[s:].set(jnp.take(perm[s:], pperm, axis=0))
         # move only the rows the panel permutation displaced (<= 2*nbw)
         # across ALL columns (the panel region is overwritten right after)
@@ -276,6 +400,7 @@ def lu(A: DistMatrix, nb: int | None = None, precision=None):
         valid = idx < (m - s)
         A = _apply_swaps_moved(A, idx + s, jnp.clip(src, 0, m - s - 1) + s,
                                valid)
+        tm.tick("swap", k, A)
         # write back the factored panel (rows s..m of cols s..e)
         if e_up > e:
             Pf_w = jnp.pad(Pf, ((0, 0), (0, e_up - e)))
@@ -285,24 +410,64 @@ def lu(A: DistMatrix, nb: int | None = None, precision=None):
         A = _update_cols_lt(A, redistribute(Pf_ss, MC, MR), (s, m), (s, e_up), e)
         # U12 := L11^{-1} A12 ; A22 -= L21 U12.  The solve runs over the full
         # legal column range (s, n) and the writeback keeps only cols >= e.
-        if e < n:
-            Li11 = _unit_lower_inv(jnp.tril(Pf[:nbw, :], -1)
-                                   + jnp.eye(nbw, dtype=Pf.dtype),
-                                   nbw, precision)
-            A1n = redistribute(view(A, rows=(s, e), cols=(s, n)), STAR, VR)
-            u1n = jnp.matmul(Li11, A1n.local, precision=_hi(precision)
-                             ).astype(Pf.dtype)
-            U1n = DistMatrix(u1n, (nbw, n - s), STAR, VR, 0, 0, g)
-            U1n_mr = redistribute(U1n, STAR, MR)
-            A = _update_cols_ge(A, redistribute(U1n_mr, MC, MR), (s, e), (s, n), e)
+        if e >= n:
+            continue
+        Li11 = _unit_lower_inv(jnp.tril(Pf[:nbw, :], -1)
+                               + jnp.eye(nbw, dtype=Pf.dtype),
+                               nbw, precision)
+        A1n = redistribute(view(A, rows=(s, e), cols=(s, n)), STAR, VR)
+        u1n = jnp.matmul(Li11, A1n.local, precision=_hi(precision)
+                         ).astype(Pf.dtype)
+        U1n = DistMatrix(u1n, (nbw, n - s), STAR, VR, 0, 0, g)
+        U1n_mr = redistribute(U1n, STAR, MR)
+        tm.tick("solve", k, U1n_mr)
+        if not lookahead or e >= kend:
+            A = _update_cols_ge(A, redistribute(U1n_mr, MC, MR), (s, e),
+                                (s, n), e)
             if e < m:      # only non-final panels: e is stride-aligned here
                 U12_mr = view(U1n_mr, cols=(e - s, n - s))
-                L21_ss = DistMatrix(Pf[nbw:, :], (m - e, nbw), STAR, STAR, 0, 0, g)
+                L21_ss = DistMatrix(Pf[nbw:, :], (m - e, nbw), STAR, STAR,
+                                    0, 0, g)
                 L21_mc = redistribute(L21_ss, MC, STAR)
-                upd = jnp.matmul(L21_mc.local, U12_mr.local, precision=precision)
-                A22 = view(A, rows=(e, m), cols=(e, n))
-                A = update_view(A, A22.with_local(A22.local - upd.astype(A.dtype)),
-                                rows=(e, m), cols=(e, n))
+                A = local_rank_update(A, L21_mc.local, U12_mr.local,
+                                      rows=(e, m), cols=(e, n),
+                                      precision=upd)
+                tm.tick("update", k, A)
+            continue
+        # look-ahead: split the trailing update at the next panel boundary.
+        # All operands are captured from the PRE-writeback A, so the panel
+        # k+1 factorization and the wide remainder matmul are data-
+        # independent and free to overlap.
+        e2 = min(e + ib, kend)
+        e2_up = col_up(e2)
+        L21_ss = DistMatrix(Pf[nbw:, :], (m - e, nbw), STAR, STAR, 0, 0, g)
+        L21_mc = redistribute(L21_ss, MC, STAR)
+        U12a = view(U1n_mr, cols=(e - s, e2_up - s))
+        A22a = view(A, rows=(e, m), cols=(e, e2_up))
+        stripD = A22a.with_local(
+            A22a.local - jnp.matmul(L21_mc.local, U12a.local,
+                                    precision=upd).astype(A.dtype))
+        # factor panel k+1 from the freshly updated strip (gshape already
+        # (m-e, e2_up-e) from the view metadata)
+        strip_ss = redistribute(stripD, STAR, STAR)
+        nxt = _panel_lu(strip_ss.local[:, :e2 - e], e2 - e, precision)
+        tm.tick("panel", k + 1, nxt)
+        # (b) wide remainder update, cols >= e2_up
+        if e2_up < n:
+            U12b = view(U1n_mr, cols=(e2_up - s, n - s))
+            A22b = view(A, rows=(e, m), cols=(e2_up, n))
+            restD = A22b.with_local(
+                A22b.local - jnp.matmul(L21_mc.local, U12b.local,
+                                        precision=upd).astype(A.dtype))
+        else:
+            restD = None
+        # writebacks (U row block, strip, remainder)
+        A = _update_cols_ge(A, redistribute(U1n_mr, MC, MR), (s, e),
+                            (s, n), e)
+        A = update_view(A, stripD, rows=(e, m), cols=(e, e2_up))
+        if restD is not None:
+            A = update_view(A, restD, rows=(e, m), cols=(e2_up, n))
+        tm.tick("update", k, A)
     return A, perm
 
 

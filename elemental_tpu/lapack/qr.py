@@ -29,6 +29,7 @@ from jax import lax
 from ..core.dist import MC, MR, VC, STAR
 from ..core.distmatrix import DistMatrix
 from ..core.view import view, update_view
+from ..core.compat import shard_map
 from ..redist.engine import redistribute
 from ..blas.level3 import _blocksize, _check_mcmr, trsm
 from .lu import _update_cols_lt, _update_cols_ge, _hi
@@ -464,7 +465,7 @@ def tsqr(A: DistMatrix):
     # float32-accurate dots: the TPU default would run the local QRs' and the
     # Q1*Q2 product's matmuls in bf16
     with jax.default_matmul_precision("highest"):
-        Qs, Rs = jax.shard_map(
+        Qs, Rs = shard_map(
             f, mesh=g.mesh, in_specs=(A.spec,),
             out_specs=(A.spec, P(None, None)), check_vma=False,
         )(A.local)

@@ -38,7 +38,9 @@ def _gso(B):
 def _lll_host(B, delta: float, eta: float = 0.51, deep: bool = False,
               max_sweeps: int = 10_000):
     """Floating LLL (Schnorr-Euchner loop) on a host array; returns
-    (B_reduced, U, n_swaps) with B_reduced = B @ U, U unimodular."""
+    (B_reduced, U, n_swaps, converged) with B_reduced = B @ U, U unimodular.
+    ``converged`` is False iff the ``max_sweeps * n`` iteration cap fired
+    before the sweep index reached n (the basis may then be unreduced)."""
     B = B.astype(np.float64).copy()
     m, n = B.shape
     U = np.eye(n)
@@ -90,20 +92,29 @@ def _lll_host(B, delta: float, eta: float = 0.51, deep: bool = False,
             U[:, [k - 1, k]] = U[:, [k, k - 1]]
             swaps += 1
             k = max(k - 1, 1)
-    return B, U, swaps
+    return B, U, swaps, k >= n
 
 
 def lll(B: DistMatrix, delta: float = 0.99, eta: float = 0.51,
-        deep: bool = False):
+        deep: bool = False, max_sweeps: int = 10_000):
     """LLL-reduce the columns of B (``El::LLL``).  Returns
     (B_reduced [MC,MR], U [MC,MR] unimodular, info) with
     ``B_reduced = B U`` and the reduced basis satisfying the
-    size-reduction (|mu_kj| <= eta) and Lovasz (delta) conditions."""
+    size-reduction (|mu_kj| <= eta) and Lovasz (delta) conditions.
+
+    ``info["converged"]`` reports whether the returned basis actually IS
+    LLL-reduced: True on normal termination; when the ``max_sweeps * n``
+    iteration cap fires mid-sweep, :func:`is_lll_reduced` is run on the
+    result (the cap can land exactly at completion) instead of silently
+    handing back a possibly-unreduced basis."""
     Bn = np.asarray(to_global(B), np.float64)
-    R, U, swaps = _lll_host(Bn, delta, eta, deep)
+    R, U, swaps, converged = _lll_host(Bn, delta, eta, deep, max_sweeps)
+    if not converged:
+        converged = is_lll_reduced(R, delta, eta)
     g = B.grid
     info = {"swaps": swaps,
-            "first_norm": float(np.linalg.norm(R[:, 0]))}
+            "first_norm": float(np.linalg.norm(R[:, 0])),
+            "converged": bool(converged)}
     return (from_global(R.astype(np.asarray(Bn).dtype), MC, MR, grid=g),
             from_global(U, MC, MR, grid=g), info)
 

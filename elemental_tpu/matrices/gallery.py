@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..core.compat import shard_map
 from ..core.dist import MC, MR
 from ..core.distmatrix import DistMatrix, from_global
 from ..core.grid import Grid, default_grid
@@ -280,7 +281,7 @@ def _device_random(m: int, n: int, grid: Grid, dtype, seed: int, sampler):
                                  r * grid.width + c)
         return sampler(key, lshape)
 
-    stor = jax.shard_map(f, mesh=grid.mesh, in_specs=(),
+    stor = shard_map(f, mesh=grid.mesh, in_specs=(),
                          out_specs=P("mc", "mr"), check_vma=False)()
     out = meta.with_local(stor)
     # re-zero padding to keep the library invariant
@@ -638,23 +639,46 @@ def one_two_one(n: int, grid: Grid | None = None, dtype=jnp.float64):
     return index_dependent_fill(A, f)
 
 
+def _log_eulerian(n: int) -> np.ndarray:
+    """log A(n, k) for k = 0..n-1 (Eulerian numbers: permutations of n with
+    k descents), via the standard recurrence
+    ``A(m,k) = (k+1) A(m-1,k) + (m-k) A(m-1,k-1)`` run in log space
+    (A(n, n/2) ~ n!, far beyond float range for the n this gallery targets).
+    O(n^2) host-side, vectorized per row."""
+    la = np.zeros(1)                              # A(1, 0) = 1
+    for m in range(2, n + 1):
+        prev_k = np.concatenate([la, [-np.inf]])       # A(m-1, k)
+        prev_k1 = np.concatenate([[-np.inf], la])      # A(m-1, k-1)
+        k = np.arange(m, dtype=np.float64)
+        la = np.logaddexp(np.log(k + 1) + prev_k,
+                          np.log(m - k) + prev_k1)
+    return la
+
+
 def riffle(n: int, grid: Grid | None = None, dtype=jnp.float64):
-    """Gilbert-Shannon-Reeds riffle-shuffle transition matrix
-    (``El::Riffle``): P[i,j] = C(n+1, 2j-i+1) * 2^{-n} * A_n-ish; we use
-    the standard closed form P[i,j] = 2^{-n} C(n+1, 2(j+1)-(i+1))
-    ... with the Eulerian normalization left to the caller."""
+    """Gilbert-Shannon-Reeds riffle-shuffle transition matrix on descent
+    classes (``El::Riffle``):
+
+        P[i,j] = 2^{-n} C(n+1, 2i-j+1) A(n,j) / A(n,i)
+
+    with A(n,k) the Eulerian numbers.  The Eulerian normalization makes P
+    row-stochastic (rows sum to 1: ``sum_i C(n+1, 2i-j+1) = 2^n`` weighted
+    by the Eulerian ratio) with stationary distribution ``A(n,i)/n!`` --
+    the descent law of a uniform permutation."""
     A = _empty(n, n, grid or default_grid(), dtype)
-    # log-binomials, precomputed host-side (O(n))
+    # log-binomials + log-Eulerian numbers, precomputed host-side
     lg = np.concatenate([[0.0], np.cumsum(np.log(np.arange(1, n + 2)))])
     lgj = jnp.asarray(lg)
+    lA = jnp.asarray(_log_eulerian(n)) if n > 0 else jnp.zeros(1)
 
     def f(i, j):
-        k = 2 * (j + 1) - (i + 1)
+        k = 2 * (i + 1) - (j + 1)
         valid = (k >= 0) & (k <= n + 1)
         kc = jnp.clip(k, 0, n + 1)
         logbin = lgj[n + 1] - lgj[kc] - lgj[n + 1 - kc]
         return jnp.where(valid,
-                         jnp.exp(logbin - n * math.log(2.0)),
+                         jnp.exp(logbin - n * math.log(2.0)
+                                 + lA[j] - lA[i]),
                          0.0).astype(dtype)
 
     return index_dependent_fill(A, f)

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core import indexing as ix
+from ..core.compat import shard_map
 from ..core.dist import (
     Dist, MC, MR, VC, VR, STAR, MD, CIRC,
     stride as dist_stride, gather_axes, rank_of, md_slot_of_global,
@@ -196,8 +197,36 @@ def _t_meta(A: DistMatrix) -> DistMatrix:
                       A.ralign, A.calign, A.grid)
 
 
+def _fused_to_star_star(A: DistMatrix) -> DistMatrix | None:
+    """[MC,MR] / [MR,MC] -> [STAR,STAR] in ONE all_gather over the flattened
+    ('mc','mr') axis + a static interleave, instead of the generic route's
+    two sequential per-dim gathers with an mn/r intermediate (the panel
+    gather of the blocked factorizations -- e.g. the LU look-ahead strip --
+    is the hot caller).  Falls back (None) on 1-D grids, where the generic
+    path is already a single collective."""
+    g = A.grid
+    r, c = g.height, g.width
+    if r == 1 or c == 1:
+        return None
+    m, n = A.gshape
+    x = A.local
+    lr, lc = x.shape
+    gx = lax.all_gather(x, ("mc", "mr"), axis=0)      # (r*c, lr, lc), mc-major
+    G = gx.reshape(r, c, lr, lc)
+    if A.dist == (MC, MR):
+        # global (i, j) = (il*r + mc, jl*c + mr)
+        full = G.transpose(2, 0, 3, 1).reshape(lr * r, lc * c)
+    else:                                             # (MR, MC)
+        # global (i, j) = (il*c + mr, jl*r + mc)
+        full = G.transpose(2, 1, 3, 0).reshape(lr * c, lc * r)
+    full = lax.slice(full, (0, 0), (m, n))
+    return DistMatrix(full, A.gshape, STAR, STAR, 0, 0, g)
+
+
 def _fused_dispatch(A: DistMatrix, dst) -> DistMatrix | None:
     src = A.dist
+    if src in ((MC, MR), (MR, MC)) and dst == (STAR, STAR):
+        return _fused_to_star_star(A)
     if src == (MC, MR) and dst == (VC, STAR):
         return _fused_to_v(A)
     if src == (MR, MC) and dst == (VR, STAR):
@@ -614,7 +643,7 @@ def _redistribute_jit(A: DistMatrix, cdist: Dist, rdist: Dist,
     def f(a):
         return to_dist(a, cdist, rdist, calign, ralign)
 
-    return jax.shard_map(
+    return shard_map(
         f, mesh=A.grid.mesh, in_specs=(A.spec,), out_specs=out_meta.spec,
         check_vma=False,
     )(A)

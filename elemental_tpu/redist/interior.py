@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core import indexing as ix
+from ..core.compat import shard_map
 from ..core.dist import Dist, MC, MR, VC, VR, stride as dist_stride, rank_of
 from ..core.distmatrix import DistMatrix
 
@@ -134,7 +135,7 @@ def interior_view(A: DistMatrix, rows=None, cols=None) -> DistMatrix:
         x = _extract_dim(x, 1, a.rdist, cs, ce, r, c)
         return out_meta.with_local(x)
 
-    return jax.shard_map(f, mesh=g.mesh, in_specs=(A.spec,),
+    return shard_map(f, mesh=g.mesh, in_specs=(A.spec,),
                          out_specs=out_meta.spec, check_vma=False)(A)
 
 
@@ -163,7 +164,7 @@ def interior_update(A: DistMatrix, B: DistMatrix, at=(0, 0)) -> DistMatrix:
         loc = _embed_dim(loc, strip, 1, a.rdist, j0, w, r, c)
         return a.with_local(loc)
 
-    return jax.shard_map(f, mesh=g.mesh, in_specs=(A.spec, B.spec),
+    return shard_map(f, mesh=g.mesh, in_specs=(A.spec, B.spec),
                          out_specs=A.spec, check_vma=False)(A, B)
 
 

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..core.compat import shard_map
 from ..core.grid import Grid, default_grid
 from ..core.multivec import DistMultiVec, _blk
 from ..core.dist import MC, MR
@@ -226,7 +227,7 @@ def _spmv(A: DistSparseMatrix, x: DistMultiVec, alpha) -> DistMultiVec:
             rows_l.reshape(-1)].add(contrib)
         return alpha * y
 
-    y = jax.shard_map(
+    y = shard_map(
         f, mesh=g.mesh,
         in_specs=(_ROWSPEC, _ROWSPEC, _ROWSPEC, x.spec),
         out_specs=out_meta.spec, check_vma=False,
@@ -252,7 +253,7 @@ def _spmv_adjoint(A: DistSparseMatrix, x: DistMultiVec, alpha) -> DistMultiVec:
         me = lax.axis_index("mc") * g.width + lax.axis_index("mr")
         return alpha * lax.dynamic_slice_in_dim(yfull, me * blk_n, blk_n)
 
-    y = jax.shard_map(
+    y = shard_map(
         f, mesh=g.mesh,
         in_specs=(_ROWSPEC, _ROWSPEC, _ROWSPEC, x.spec),
         out_specs=out_meta.spec, check_vma=False,

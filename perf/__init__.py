@@ -1,0 +1,1 @@
+"""Perf tooling: same-process A/B harness + reusable phase timing."""

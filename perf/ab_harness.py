@@ -5,9 +5,22 @@ each timing with a matmul roofline measurement so chip-weather is factored
 out per-variant (the r4 lesson: never land a "perf" change without a
 before/after pair).  Usage:
 
-    python perf/ab_harness.py chol     # Cholesky variants at N=32768
-    python perf/ab_harness.py lu       # LU variants at N=16384
-    python perf/ab_harness.py phases   # LU phase breakdown (panel vs rest)
+    python perf/ab_harness.py chol          # Cholesky variants at N=32768
+    python perf/ab_harness.py lu [N]        # LU: classic vs look-ahead,
+                                            #   nb + _INNERS sweep (dflt 16384)
+    python perf/ab_harness.py phases [N NB] # per-step panel/swap/solve/update
+                                            #   wall-clock as one JSON line
+
+``lu`` is the look-ahead A/B pair from ISSUE 1: the first two variants are
+the classic right-looking schedule and the pipelined look-ahead schedule at
+identical (nb, _INNERS), same process, roofline-bracketed; the rest sweep
+nb, the _INNERS chunk ladder, and the bf16 trailing-update knob
+(``update_precision=DEFAULT``, residual printed alongside).
+
+``phases`` drives ``perf.phase_timer.PhaseTimer`` through the real ``lu``
+driver (eagerly, sync at each phase boundary) and emits the
+``phase_timings/v1`` JSON -- the hook future perf PRs use to attribute
+regressions.
 """
 import os
 import sys
@@ -29,6 +42,7 @@ chol_mod = importlib.import_module("elemental_tpu.lapack.cholesky")
 lu_mod = importlib.import_module("elemental_tpu.lapack.lu")
 
 HI = jax.lax.Precision.HIGHEST
+DEF = jax.lax.Precision.DEFAULT
 
 
 def _min3(fn, reps=3):
@@ -75,9 +89,9 @@ def timed(make_input, step, reps=3):
     return max(min(times) - LAT, 1e-9)
 
 
-def report(name, tflops, roof):
-    print(f"{name:40s} {tflops:8.3f} TFLOP/s   roof {roof:6.2f}"
-          f"   norm {100 * tflops / roof:5.1f}%", flush=True)
+def report(name, tflops, roof, extra=""):
+    print(f"{name:44s} {tflops:8.3f} TFLOP/s   roof {roof:6.2f}"
+          f"   norm {100 * tflops / roof:5.1f}%{extra}", flush=True)
 
 
 def run_chol():
@@ -125,27 +139,43 @@ def run_chol():
     chol_mod._potrf_inv = orig
 
 
-def run_lu():
-    n, grid = 16384, el.Grid([jax.devices()[0]])
+def run_lu(n=None):
+    on_tpu = jax.devices()[0].platform != "cpu"
+    n = int(n) if n else (16384 if on_tpu else 512)
+    grid = el.Grid([jax.devices()[0]])
 
     def wrap(a):
         return el.DistMatrix(a, (n, n), el.MC, el.MR, 0, 0, grid)
 
     gen = jax.jit(lambda: jax.random.normal(jax.random.PRNGKey(1), (n, n),
                                             jnp.float32))
+    nb0 = 2048 if on_tpu else 128
+
+    # (name, lookahead, inners, nb, update_precision)
+    cases = [
+        (f"classic        inners=(512,64) nb={nb0}", False, (512, 64), nb0, None),
+        (f"look-ahead     inners=(512,64) nb={nb0}", True, (512, 64), nb0, None),
+        (f"look-ahead     inners=(512,64) nb={nb0 // 2}", True, (512, 64),
+         nb0 // 2, None),
+        (f"look-ahead     inners=(512,64) nb={nb0 * 2}", True, (512, 64),
+         nb0 * 2, None),
+        (f"look-ahead     inners=(768,96) nb={nb0}", True, (768, 96), nb0, None),
+        (f"look-ahead     inners=(1024,128) nb={nb0}", True, (1024, 128),
+         nb0, None),
+        (f"look-ahead     inners=(512,128,32) nb={nb0}", True, (512, 128, 32),
+         nb0, None),
+        (f"look-ahead+bf16upd inners=(512,64) nb={nb0}", True, (512, 64),
+         nb0, DEF),
+    ]
 
     orig_inners = lu_mod._INNERS
-    cases = []
-    for inners in ((512, 64), (256, 64), (512, 64), (1024, 128),
-                   (512, 64, 16), (768, 96)):
-        cases.append((f"inners={inners} nb=2048", inners, 2048))
-    cases.append((f"inners=(512,64) nb=3072", (512, 64), 3072))
-
-    for name, inners, nb in cases:
+    for name, la, inners, nb, upd in cases:
         lu_mod._INNERS = inners
-        lufn = jax.jit(lambda a, _nb=nb: tuple(el.lu(a, nb=_nb,
-                                                     precision=HI)),
-                       donate_argnums=0)
+        lufn = jax.jit(
+            lambda a, _nb=nb, _la=la, _u=upd: tuple(
+                el.lu(a, nb=_nb, precision=HI, update_precision=_u,
+                      lookahead=_la)),
+            donate_argnums=0)
 
         def step(A):
             LU, perm = lufn(A)
@@ -154,48 +184,44 @@ def run_lu():
         r0 = roofline()
         dt = timed(lambda: wrap(gen()), step)
         r1 = roofline()
-        report(name, (2 * n ** 3 / 3) / dt / 1e12, 0.5 * (r0 + r1))
+        extra = ""
+        if upd is not None:
+            # residual at the relaxed trailing precision (documents the
+            # bf16 knob's accuracy cost next to its speedup)
+            LU, perm = lufn(wrap(gen()))
+            mres = gen()
+            v = jax.random.normal(jax.random.PRNGKey(3), (n, 1), jnp.float32)
+            uv = jnp.matmul(jnp.triu(LU.local), v, precision=HI)
+            luv = jnp.matmul(jnp.tril(LU.local, -1), uv, precision=HI) + uv
+            pav = jnp.matmul(jnp.take(mres, perm, axis=0), v, precision=HI)
+            resid = float(jnp.linalg.norm(pav - luv)
+                          / (jnp.linalg.norm(mres) * jnp.linalg.norm(v)))
+            extra = f"   resid {resid:.2e}"
+            del LU, perm, mres
+        report(name, (2 * n ** 3 / 3) / dt / 1e12, 0.5 * (r0 + r1), extra)
         del lufn
     lu_mod._INNERS = orig_inners
 
 
-def run_phases():
-    """Time the LU panel factorization alone vs a full matmul of the same
-    trailing update shape, to see where the 2/3 n^3 budget goes."""
-    m, nbw = 16384, 2048
-
-    def sync(x):
-        return float(jax.tree_util.tree_leaves(x)[0].ravel()[0])
-
-    P = jax.random.normal(jax.random.PRNGKey(4), (m, nbw), jnp.float32)
-    for inners in ((256, 32), (512, 64), (128, 16), (64,), (1024, 128, 16)):
-        pan = jax.jit(lambda p, _i=inners: lu_mod._panel_lu(p, nbw, HI, _i))
-        sync(pan(P))
-        dt = max(_min3(lambda: sync(pan(P))) - LAT, 1e-9)
-        print(f"panel m={m} nbw={nbw} inners={inners}: {dt*1e3:8.2f} ms",
-              flush=True)
-    # trailing update matmul for the first panel: (m-nbw, nbw) @ (nbw, m-nbw)
-    A = jax.random.normal(jax.random.PRNGKey(5), (m - nbw, nbw), jnp.float32)
-    B = jax.random.normal(jax.random.PRNGKey(6), (nbw, m - nbw), jnp.float32)
-    mm = jax.jit(lambda a, b: jnp.matmul(a, b, precision=HI))
-    sync(mm(A, B))
-    dt = max(_min3(lambda: sync(mm(A, B))) - LAT, 1e-9)
-    fl = 2 * (m - nbw) ** 2 * nbw
-    print(f"trailing mm {m-nbw}x{nbw}x{m-nbw}: {dt*1e3:8.2f} ms "
-          f"({fl/dt/1e12:.2f} TFLOP/s)", flush=True)
-    # full-trailing row gather (the swap cost): take + writeback of m x m
-    G = jax.random.normal(jax.random.PRNGKey(7), (m, m), jnp.float32)
-    pp = jnp.arange(m)[::-1]
-    gat = jax.jit(lambda a: a.at[0:].set(jnp.take(a, pp, axis=0)),
-                  donate_argnums=0)
-    sync(gat(G))
-    G = jax.random.normal(jax.random.PRNGKey(7), (m, m), jnp.float32)
-    sync(G)
-    t0 = time.perf_counter()
-    sync(gat(G))
-    print(f"full {m}x{m} row-permute: "
-          f"{(time.perf_counter()-t0-LAT)*1e3:8.2f} ms", flush=True)
-    print(f"roofline now: {roofline():.2f}", flush=True)
+def run_phases(n=None, nb=None):
+    """Per-step panel/swap/solve/update wall-clock through the REAL lu
+    driver (eager, PhaseTimer syncs at each boundary) -> one JSON line."""
+    from perf.phase_timer import PhaseTimer
+    on_tpu = jax.devices()[0].platform != "cpu"
+    n = int(n) if n else (16384 if on_tpu else 512)
+    nb = int(nb) if nb else (2048 if on_tpu else 128)
+    grid = el.Grid([jax.devices()[0]])
+    a = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.float32)
+    A = el.DistMatrix(a, (n, n), el.MC, el.MR, 0, 0, grid)
+    jax.block_until_ready(a)
+    t = PhaseTimer()
+    LU, perm = el.lu(A, nb=nb, precision=HI, lookahead=True, timer=t)
+    jax.block_until_ready((LU.local, perm))
+    r = roofline()
+    print(t.json(driver="lu", n=n, nb=nb, lookahead=True,
+                 inners=list(lu_mod._INNERS),
+                 flops=2 * n ** 3 / 3, roofline_tflops=round(r, 2),
+                 device=jax.devices()[0].device_kind), flush=True)
 
 
 if __name__ == "__main__":
@@ -204,11 +230,12 @@ if __name__ == "__main__":
     t = jnp.zeros(())
     float(tiny(t))
     LAT = _min3(lambda: float(tiny(t)))
-    print(f"device {jax.devices()[0].device_kind}, rt latency {LAT*1e3:.2f} ms",
-          flush=True)
+    if mode != "phases":
+        print(f"device {jax.devices()[0].device_kind}, "
+              f"rt latency {LAT*1e3:.2f} ms", flush=True)
     if mode == "chol":
         run_chol()
     elif mode == "lu":
-        run_lu()
+        run_lu(*sys.argv[2:3])
     else:
-        run_phases()
+        run_phases(*sys.argv[2:4])

@@ -15,9 +15,14 @@ os.environ.setdefault("JAX_ENABLE_X64", "true")
 import jax  # noqa: E402
 
 # jax is pre-imported at interpreter startup in this image (axon plugin .pth),
-# so env vars alone are too late; config.update works pre-backend-init.
+# so env vars alone are too late; config.update works pre-backend-init.  On
+# older jax builds without jax_num_cpu_devices the XLA_FLAGS path above (set
+# before any import in a non-pre-imported interpreter) provides the 8 devices.
 jax.config.update("jax_platform_name", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
 jax.config.update("jax_enable_x64", True)
 
 # Persistent XLA compilation cache: suite wall-time is dominated by compiles

@@ -85,6 +85,7 @@ def test_contract_mc_star(grid24):
         return engine.contract(a, MC, MR)
 
     out_meta = zeros(9, 10, MC, MR, grid=grid24, dtype=F.dtype)
-    B = jax.shard_map(fn, mesh=grid24.mesh, in_specs=(A.spec,),
-                      out_specs=out_meta.spec, check_vma=False)(A)
+    from elemental_tpu.core.compat import shard_map
+    B = shard_map(fn, mesh=grid24.mesh, in_specs=(A.spec,),
+                  out_specs=out_meta.spec, check_vma=False)(A)
     np.testing.assert_allclose(np.asarray(to_global(B)), F, rtol=1e-12)

@@ -79,6 +79,42 @@ def test_quasi_trsm(two_grids, side, orient):
     assert np.allclose(_t(X), ref, atol=1e-9)
 
 
+def _quasi_upper_complex(rng, n, nblocks2x2):
+    """Random well-conditioned COMPLEX upper quasi-triangular matrix."""
+    T = np.triu(rng.normal(size=(n, n)) + 1j * rng.normal(size=(n, n))) \
+        + 4 * np.eye(n)
+    pos = rng.choice(n - 1, nblocks2x2, replace=False)
+    pos = [p for p in sorted(pos) if p == 0 or (p - 1 not in pos)]
+    for p in pos:
+        a, b = T[p, p], (1.0 + abs(rng.normal())) * (1 + 0.5j)
+        T[p + 1, p + 1] = a
+        T[p, p + 1] = b
+        T[p + 1, p] = -np.conj(b)
+    return T
+
+
+@pytest.mark.parametrize("side,orient", [("L", "C"), ("R", "C"),
+                                         ("L", "N"), ("R", "T")])
+def test_quasi_trsm_complex_conj(two_grids, side, orient):
+    """quasi_trsm with complex operands, exercising the conj branches of
+    the panel solve and off-panel update (orient 'C': op(T) = T^H), vs
+    numpy.linalg.solve on the conjugate-transposed system."""
+    rng = np.random.default_rng(9)
+    n, k = 37, 5
+    T = _quasi_upper_complex(rng, n, 6)
+    B = rng.normal(size=(n, k) if side == "L" else (k, n)) \
+        + 1j * rng.normal(size=(n, k) if side == "L" else (k, n))
+    def _gc(F):          # complex-preserving (module _g casts to float64)
+        return el.from_global(np.asarray(F, np.complex128), el.MC, el.MR,
+                              grid=two_grids)
+
+    X = el.quasi_trsm(side, orient, _gc(T), _gc(B), nb=8)
+    opT = {"N": T, "T": T.T, "C": np.conj(T).T}[orient]
+    ref = np.linalg.solve(opT, B) if side == "L" \
+        else (B @ np.linalg.inv(opT))
+    assert np.allclose(_t(X), ref, atol=1e-9)
+
+
 def test_quasi_trsm_matches_trsm_on_triangular(two_grids):
     """With zero subdiagonal, quasi_trsm must agree with plain trsm."""
     rng = np.random.default_rng(3)

@@ -53,6 +53,25 @@ def test_lll_knapsack_short_vector(grid24):
     assert norms.min() <= np.sqrt(n) + 1e-6     # found a (x,0)-class vector
 
 
+def test_lll_converged_flag(grid24):
+    """info['converged'] is True on normal termination and False when the
+    sweep cap exits with an unreduced basis (instead of a silent return)."""
+    rng = np.random.default_rng(7)
+    n = 8
+    B = rng.integers(-30, 30, (n, n)).astype(np.float64)
+    while abs(np.linalg.det(B)) < 1:
+        B = rng.integers(-30, 30, (n, n)).astype(np.float64)
+    R, U, info = el.lll(_g(B, grid24))
+    assert info["converged"] is True
+    assert el.is_lll_reduced(R)
+    # max_sweeps=0: the loop cannot run, the unreduced input comes back,
+    # and the flag (backed by an is_lll_reduced check on cap exit) says so
+    R0, U0, info0 = el.lll(_g(B, grid24), max_sweeps=0)
+    assert not el.is_lll_reduced(R0)
+    assert info0["converged"] is False
+    np.testing.assert_allclose(np.asarray(el.to_global(R0)), B)
+
+
 def test_lll_deep_and_svp(grid24):
     rng = np.random.default_rng(2)
     n = 6

@@ -249,9 +249,35 @@ class TestGalleryBreadth:
         assert np.allclose(np.sort(w), np.sort(2 + 2 * np.cos(k * np.pi / 11)))
 
     def test_riffle_stochastic(self, grid24):
+        """El::Riffle semantics: the Eulerian-normalized transition matrix
+        P[i,j] = 2^{-n} C(n+1, 2j-i+1) A(n,j)/A(n,i) is row-stochastic with
+        stationary law A(n,i)/n! (the descent distribution)."""
+        import math
         import numpy as np
-        P = np.asarray(el.to_global(el.matrices.riffle(6, grid=grid24)))
+        n = 6
+        P = np.asarray(el.to_global(el.matrices.riffle(n, grid=grid24)))
         assert np.all(P >= 0)
+        np.testing.assert_allclose(P.sum(axis=1), np.ones(n), rtol=1e-12)
+        # pin against the exact integer Eulerian numbers
+        A = [1]
+        for m in range(2, n + 1):
+            A = [(k + 1) * (A[k] if k < len(A) else 0)
+                 + (m - k) * (A[k - 1] if k >= 1 else 0) for k in range(m)]
+        assert A == [1, 57, 302, 302, 57, 1] and sum(A) == math.factorial(n)
+        ref = np.zeros((n, n))
+        for i in range(n):
+            for j in range(n):
+                k = 2 * i - j + 1
+                if 0 <= k <= n + 1:
+                    ref[i, j] = math.comb(n + 1, k) * A[j] / (2 ** n * A[i])
+        np.testing.assert_allclose(P, ref, rtol=1e-12)
+        # exact known entries: P[0,0] = C(7,1)/2^6 = 7/64 and
+        # P[0,1] = C(7,0) A(6,1)/(2^6 A(6,0)) = 57/64
+        assert np.isclose(P[0, 0], 7 / 64)
+        assert np.isclose(P[0, 1], 57 / 64)
+        # stationary distribution: pi_i = A(n,i)/n!
+        pi = np.asarray(A) / math.factorial(n)
+        np.testing.assert_allclose(pi @ P, pi, rtol=1e-12)
 
     def test_ris(self, grid24):
         import numpy as np

@@ -1,0 +1,67 @@
+"""Perf-observability smoke: a tiny LU through the phase-timing hook.
+
+Slow-tier guard for the ``perf/phase_timer.py`` + ``lu(..., timer=...)``
+path (ISSUE 1 CI satellite): asserts the ``phase_timings/v1`` JSON schema
+so the attribution tooling future perf PRs rely on cannot silently rot.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import elemental_tpu as el
+
+pytestmark = pytest.mark.slow
+
+
+def _check_schema(doc, n, nb, nsteps):
+    from perf.phase_timer import SCHEMA, PHASES
+    assert doc["schema"] == SCHEMA
+    assert doc["driver"] == "lu"
+    assert doc["n"] == n and doc["nb"] == nb
+    steps = doc["steps"]
+    assert [s["step"] for s in steps] == list(range(nsteps))
+    for srec in steps:
+        phases = set(srec) - {"step"}
+        assert phases <= set(PHASES)
+        assert "panel" in phases and "swap" in phases
+        for p in phases:
+            assert isinstance(srec[p], float) and srec[p] >= 0.0
+    totals = doc["totals"]
+    assert set(totals) <= set(PHASES) and "panel" in totals
+    assert doc["total_seconds"] >= sum(totals.values()) - 1e-9
+    json.dumps(doc)          # round-trippable
+
+
+@pytest.mark.parametrize("lookahead", [True, False])
+def test_lu_phase_timer_schema_distributed(grid24, lookahead):
+    from perf.phase_timer import PhaseTimer
+    n, nb = 48, 16
+    rng = np.random.default_rng(0)
+    F = rng.normal(size=(n, n)) + n * np.eye(n)
+    A = el.from_global(F, el.MC, el.MR, grid=grid24)
+    t = PhaseTimer()
+    LU, perm = el.lu(A, nb=nb, lookahead=lookahead, timer=t)
+    doc = json.loads(t.json(driver="lu", n=n, nb=nb, lookahead=lookahead))
+    _check_schema(doc, n, nb, nsteps=n // nb)
+    # the timed run is still a correct factorization
+    LUh = np.asarray(el.to_global(LU))
+    L = np.tril(LUh, -1) + np.eye(n)
+    U = np.triu(LUh)
+    p = np.asarray(perm)
+    assert np.linalg.norm(F[p, :] - L @ U) < 1e-11 * np.linalg.norm(F)
+
+
+def test_lu_phase_timer_schema_local():
+    """Same schema off the sequential (1x1-grid) driver."""
+    import jax
+    from perf.phase_timer import PhaseTimer
+    g1 = el.Grid([jax.devices()[0]])
+    n, nb = 64, 16
+    rng = np.random.default_rng(1)
+    F = rng.normal(size=(n, n)) + n * np.eye(n)
+    A = el.from_global(F, el.MC, el.MR, grid=g1)
+    t = PhaseTimer()
+    LU, perm = el.lu(A, nb=nb, timer=t)
+    doc = json.loads(t.json(driver="lu", n=n, nb=nb))
+    _check_schema(doc, n, nb, nsteps=n // nb)
