@@ -15,6 +15,12 @@ NOTE on timing: on tunneled devices (axon) ``block_until_ready`` returns
 before remote execution completes, and every host round-trip costs a fixed
 latency.  We force completion with a scalar device->host read and subtract
 the measured round-trip latency of a trivial op.
+
+``--phases`` additionally drives one EAGER Cholesky through the
+``perf.phase_timer.PhaseTimer`` hook and emits its per-step
+diag/panel/update breakdown as a second ``phase_timings/v1`` JSON line
+after the headline (at a reduced N on TPU: the eager run holds more live
+buffers than the donate-input jit).
 """
 import json
 import sys
@@ -194,6 +200,30 @@ def main():
         "resid": f"{resid:.2e}",
         "lu_resid": f"{lu_resid:.2e}",
     }))
+
+    if "--phases" in sys.argv[1:]:
+        # cholesky phase attribution alongside the headline: one eager run
+        # through the PhaseTimer hook (smaller N on TPU -- the eager driver
+        # cannot donate its input)
+        from perf.phase_timer import PhaseTimer
+        del lu_arr, perm
+        n_ph = min(n_chol, 16384) if on_tpu else n_chol
+
+        @jax.jit
+        def gen_ph():
+            G = jax.random.normal(jax.random.PRNGKey(0), (n_ph, n_ph),
+                                  jnp.float32)
+            return jnp.matmul(G, G.T) / n_ph \
+                + n_ph * jnp.eye(n_ph, dtype=jnp.float32)
+
+        Ap = wrap(gen_ph(), n_ph)
+        jax.block_until_ready(Ap.local)
+        t = PhaseTimer()
+        Lp = el.cholesky(Ap, nb=nb, precision=HI, timer=t)
+        jax.block_until_ready(Lp.local)
+        print(t.json(driver="cholesky", n=n_ph, nb=nb, lookahead=True,
+                     flops=n_ph ** 3 / 3,
+                     device=getattr(dev, "device_kind", dev.platform)))
     return 0
 
 

@@ -21,7 +21,7 @@ from .core.multivec import (DistMultiVec, mv_from_global, mv_to_global,
                             mv_zeros, mv_axpy, mv_scale, mv_dot, mv_nrm2,
                             mv_remote_updates, mv_to_distmatrix,
                             mv_from_distmatrix)
-from .redist.engine import redistribute, transpose_dist
+from .redist.engine import redistribute, transpose_dist, panel_spread
 
 __version__ = "0.2.0"
 

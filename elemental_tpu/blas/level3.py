@@ -32,7 +32,7 @@ from jax import lax
 from ..core.dist import MC, MR, VC, VR, STAR
 from ..core.distmatrix import DistMatrix, zeros as dm_zeros
 from ..core.view import view, update_view, round_up
-from ..redist.engine import to_dist, redistribute, transpose_dist
+from ..redist.engine import to_dist, redistribute, transpose_dist, panel_spread
 from .level1 import _global_indices
 
 
@@ -215,20 +215,30 @@ def _summa_dot(alpha, A, B, beta, C, precision):
     inner dimension 1-D cyclic on BOTH operands ([STAR,VC] x [VC,STAR] --
     the same cyclic permutation on each side, so the storage matmul
     contracts correctly), local (m, k/p) x (k/p, n) products, one psum
-    over all devices into the replicated C, filter onto [MC,MR]."""
+    over all devices into the replicated C, filter onto [MC,MR].
+
+    On a 1x1 grid the storage arrays ARE the global operands, so the
+    [STAR,VC] round-trip is pure dispatch overhead: early-out to one local
+    matmul.  ``beta`` may be any scalar (incl. complex); a complex result
+    landing in a real C still raises through :func:`_safe_astype`."""
     m, n = C.gshape
-    Avc = redistribute(A, STAR, VC)
-    Bvc = redistribute(B, VC, STAR)
-    d = jnp.matmul(Avc.local, Bvc.local, precision=precision)
-    D = DistMatrix(d, (m, n), STAR, STAR, 0, 0, A.grid)
-    out = redistribute(D, MC, MR)
+    if A.grid.size == 1:
+        d = jnp.matmul(A.local, B.local, precision=precision)
+    else:
+        Avc = redistribute(A, STAR, VC)
+        Bvc = redistribute(B, VC, STAR)
+        dl = jnp.matmul(Avc.local, Bvc.local, precision=precision)
+        D = DistMatrix(dl, (m, n), STAR, STAR, 0, 0, A.grid)
+        d = redistribute(D, MC, MR).local
     return C.with_local(_safe_astype(
-        alpha * out.local + (beta * C.local if _nonzero(beta) else 0),
+        alpha * d + (beta * C.local if _nonzero(beta) else 0),
         C.dtype))
 
 
 def _nonzero(x) -> bool:
-    return not (isinstance(x, (int, float)) and x == 0)
+    # complex(0) counts as zero: a 0j beta must not force a complex
+    # accumulator (and a TypeError out of _safe_astype) onto a real C
+    return not (isinstance(x, (int, float, complex)) and x == 0)
 
 
 def _safe_astype(x, dtype):
@@ -268,9 +278,10 @@ def herk(uplo: str, A: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None = N
          conj: bool = True) -> DistMatrix:
     """C(tri) := alpha op(A) op(A)^H + beta C(tri)  (orient 'N' or 'C'/'T').
 
-    Per k-panel: A1 -> [VC,STAR], spread to [MC,STAR]; the adjoint panel
-    rides the V-ladder to [STAR,MR] (the Cholesky trailing-update chain,
-    cf. ``cholesky::LVar3``); masked local update.
+    Per k-panel: A1 -> [VC,STAR], then the fused engine ``panel_spread``
+    produces the [MC,STAR] panel and its [STAR,MR] adjoint in ONE
+    collective round (the Cholesky trailing-update chain, cf.
+    ``cholesky::LVar3``); masked local update.
     """
     if orient != "N":
         A = _orient(A, "C" if conj else "T")
@@ -290,8 +301,7 @@ def herk(uplo: str, A: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None = N
     for s in range(0, k, kb):
         e = min(s + kb, k)
         A1_vc = redistribute(view(A, cols=(s, e)), VC, STAR)
-        A1_mc = redistribute(A1_vc, MC, STAR)
-        A1H_mr = redistribute(transpose_dist(A1_vc, conj=conj), STAR, MR)
+        A1_mc, A1H_mr = panel_spread(A1_vc, conj=conj)
         acc = acc + alpha * jnp.matmul(A1_mc.local, A1H_mr.local, precision=precision)
     return C.with_local(jnp.where(mask, _safe_astype(acc, C.dtype), C.local))
 
@@ -496,8 +506,8 @@ def her2k(uplo: str, A: DistMatrix, B: DistMatrix, alpha=1.0, beta=0.0,
     (``El::Her2k``; ``conj=False`` gives ``Syr2k`` with ^T and coefficient
     alpha on both products).
 
-    Same panel schedule as :func:`herk` (the ``cholesky::LVar3`` chain), two
-    masked storage products per k-panel."""
+    Same panel schedule as :func:`herk` (the ``cholesky::LVar3`` chain via
+    the fused ``panel_spread``), two masked storage products per k-panel."""
     if orient != "N":
         A = _orient(A, "C" if conj else "T")
         B = _orient(B, "C" if conj else "T")
@@ -524,10 +534,8 @@ def her2k(uplo: str, A: DistMatrix, B: DistMatrix, alpha=1.0, beta=0.0,
         e = min(s + kb, k)
         A1_vc = redistribute(view(A, cols=(s, e)), VC, STAR)
         B1_vc = redistribute(view(B, cols=(s, e)), VC, STAR)
-        A1_mc = redistribute(A1_vc, MC, STAR)
-        B1_mc = redistribute(B1_vc, MC, STAR)
-        A1H_mr = redistribute(transpose_dist(A1_vc, conj=conj), STAR, MR)
-        B1H_mr = redistribute(transpose_dist(B1_vc, conj=conj), STAR, MR)
+        A1_mc, A1H_mr = panel_spread(A1_vc, conj=conj)
+        B1_mc, B1H_mr = panel_spread(B1_vc, conj=conj)
         acc = acc + alpha * jnp.matmul(A1_mc.local, B1H_mr.local, precision=precision) \
             + alpha2 * jnp.matmul(B1_mc.local, A1H_mr.local, precision=precision)
     return C.with_local(jnp.where(mask, _safe_astype(acc, C.dtype), C.local))

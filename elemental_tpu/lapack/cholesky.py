@@ -1,4 +1,4 @@
-"""Blocked distributed Cholesky + SPD solve.
+"""Blocked distributed Cholesky + SPD solve, look-ahead pipelined.
 
 Reference: Elemental ``src/lapack_like/factor/Cholesky.cpp`` +
 ``Cholesky/LVar3.hpp`` (blocked right-looking lower variant) and
@@ -8,14 +8,47 @@ Reference: Elemental ``src/lapack_like/factor/Cholesky.cpp`` +
 Per panel (the LVar3 loop, SURVEY.md §4.2):
   A11 -> [STAR,STAR]            replicated diagonal block, local potrf
   A21 -> [VC,STAR]              1-D cyclic panel, local right-Trsm by L11^H
-  L21 -> [MC,STAR]              partial AllGather over mr
-  L21^H -> [STAR,MR]            V-ladder adjoint chain (VC->transpose->MR)
+  (L21, L21^H) spread           fused engine ``panel_spread``: [MC,STAR]
+                                and the [STAR,MR] adjoint in ONE collective
   A22 -= L21 L21^H (lower tri)  one storage matmul on the MXU, masked
 
-All panel moves are engine fast paths; the trailing update is the
-[MC,STAR] x [STAR,MR] pure-local product (``LocalTrrk``).  Loops are
-Python-unrolled with static shrinking shapes -- no wasted FLOPs on
-already-factored regions (total 1/3 n^3, matching the reference).
+Look-ahead schedule (default on; the Cholesky twin of lu.py's pipeline)
+-----------------------------------------------------------------------
+The classic right-looking driver serializes diag -> panel -> spread ->
+update every step, leaving the latency-bound replicated ``_potrf_inv`` on
+the critical path ``n/nb`` times.  The pipelined driver splits step k's
+trailing update at the next panel boundary:
+
+    write back L11_k                          (from the carried factor)
+    (L21, L21^H) := panel_spread(L21_vc)      (one fused collective)
+    strip := A22[:, :nb] - L21 L21^H[:, :nb]  (narrow column-strip update)
+    factor diag block k+1 from ``strip``      (off the critical path)
+    solve panel k+1 from ``strip``            (off the critical path)
+    rest := A22[:, nb:] - L21 L21^H[:, nb:]   (wide MXU update)
+
+The strip/rest/diag operands are all captured BEFORE any writeback, so the
+replicated ``_potrf_inv`` of step k+1 and the wide remainder matmul share
+no data dependence and XLA is free to overlap them.  ``lookahead=False``
+keeps the classic order -- bit-identical factors, the A/B baseline
+(``perf/ab_harness.py cholesky``).
+
+Tail crossover-to-local (``crossover``)
+---------------------------------------
+The shrinking tail pays full per-step redistribution latency on ever
+smaller trailing matmuls.  Once the trailing matrix drops to ``crossover``
+(default :data:`_CROSSOVER` when look-ahead is on; 0 disables), it is
+gathered ONCE to [STAR,STAR] and finished with the replicated sequential
+schedule (:func:`_local_chol_array`) -- O(t^3) redundant flops on every
+device, but zero further collectives.  ``crossover=None`` picks the
+default; pass an int to override (``perf/ab_harness.py cholesky`` sweeps
+it).
+
+Phase timing (``timer``)
+------------------------
+Pass a ``perf.phase_timer.PhaseTimer`` and call ``cholesky`` EAGERLY: the
+driver ticks at every diag / panel / spread / update (/tail) boundary and
+the timer attributes per-step wall-clock (same ``phase_timings/v1`` schema
+as LU; ``python perf/ab_harness.py phases cholesky`` is the CLI).
 """
 from __future__ import annotations
 
@@ -27,10 +60,18 @@ from jax import lax
 from ..core.dist import MC, MR, VC, STAR
 from ..core.distmatrix import DistMatrix
 from ..core.view import view, update_view
-from ..redist.engine import redistribute, transpose_dist
-from ..blas.level1 import make_trapezoidal
+from ..redist.engine import redistribute, transpose_dist, panel_spread
+from ..blas.level1 import make_trapezoidal, _global_indices
 from ..blas.level3 import _blocksize, _check_mcmr, _mask_triangle, trsm
-from .lu import _hi
+from .lu import _hi, _NULL_TIMER
+
+#: Trailing-matrix size at which the distributed loop gathers the tail and
+#: finishes locally (look-ahead schedule only, unless overridden).  The
+#: per-step cost floor of the distributed loop is ~3 collective rounds; at
+#: t <= ~4k the whole remaining O(t^3/3) factors locally in less time than
+#: the remaining t/nb rounds cost.  Re-pin via ``perf/ab_harness.py
+#: cholesky`` (crossover sweep) on the target chip/grid.
+_CROSSOVER = 4096
 
 
 def _potrf_inv(D, precision, bs: int = 512):
@@ -84,11 +125,12 @@ def _potrf_inv(D, precision, bs: int = 512):
     return L, Li
 
 
-def _local_cholesky(A: DistMatrix, nb: int | None, precision) -> DistMatrix:
-    """Sequential (p == 1) lower path: the analog of the reference's local
-    ``Matrix<T>`` dispatch onto sequential BLAS.  On a 1x1 grid the storage
-    array IS the global matrix, so the whole blocked loop is one fused XLA
-    program with no shard_map/redistribute sub-computation boundaries.
+def _local_chol_array(a, n: int, ib: int, precision, lookahead: bool = True,
+                      timer=None):
+    """Blocked lower Cholesky of an (n, n) array (lower triangle valid),
+    returning the full lower-triangular factor array.  Shared by the p == 1
+    driver and the distributed tail crossover (where it runs REPLICATED on
+    the gathered trailing block -- deterministic, so every device agrees).
 
     Schedule (tuned on v5e at N=32768):
       * diagonal blocks factored by :func:`_potrf_inv` (small-base potrf +
@@ -100,81 +142,239 @@ def _local_cholesky(A: DistMatrix, nb: int | None, precision) -> DistMatrix:
       * the rank-nb update touches only the LOWER triangle, via row-stripe
         blocks ``T[i:i+q, :i+q] -= L21[i:i+q] L21[:i+q]^H`` (contiguous
         row-major writes; half the FLOPs of the full product -- the MXU
-        answer to the reference's recursive ``Trrk``)."""
-    a = A.local
-    n = A.gshape[0]
-    ib = max(nb or 2048, 1)
+        answer to the reference's recursive ``Trrk``);
+      * ``lookahead=True`` additionally computes the next panel's column
+        strip first and factors diag block k+1 + its panel solve from it,
+        so the latency-bound ``_potrf_inv`` inner loop is data-independent
+        of the wide remainder stripes and XLA may overlap them (the same
+        pipeline as ``lu._local_lu``)."""
+    tm = timer if timer is not None else _NULL_TIMER
+    dt = a.dtype
     q = 2 * ib
     panels = []
     T = a
-    for s in range(0, n, ib):
+    nxt = None
+    if lookahead:
+        w0 = min(ib, n)
+        L11, Li11 = _potrf_inv(T[:w0, :w0], precision)
+        tm.tick("diag", 0, L11)
+        L21 = None
+        if w0 < n:
+            L21 = jnp.matmul(T[w0:, :w0], jnp.conj(Li11).T,
+                             precision=_hi(precision)).astype(dt)
+            tm.tick("panel", 0, L21)
+        nxt = (L11, Li11, L21)
+    for k, s in enumerate(range(0, n, ib)):
         w = min(ib, n - s)
-        L11, Li11 = _potrf_inv(T[:w, :w], precision)
+        if lookahead:
+            L11, Li11, L21 = nxt
+        else:
+            L11, Li11 = _potrf_inv(T[:w, :w], precision)
+            tm.tick("diag", k, L11)
+            L21 = None
+            if s + w < n:
+                L21 = jnp.matmul(T[w:, :w], jnp.conj(Li11).T,
+                                 precision=_hi(precision)).astype(dt)
+                tm.tick("panel", k, L21)
         if s + w == n:
             panels.append(L11)
             break
-        L21 = jnp.matmul(T[w:, :w], jnp.conj(Li11).T,
-                         precision=_hi(precision)).astype(a.dtype)
         panels.append(jnp.concatenate([L11, L21], axis=0))
         T2 = T[w:, w:]
         mt = T2.shape[0]
-        for i in range(0, mt, q):
+        if not lookahead:
+            for i in range(0, mt, q):
+                iq = min(i + q, mt)
+                upd = jnp.matmul(L21[i:iq, :], jnp.conj(L21[:iq, :]).T,
+                                 precision=precision)
+                T2 = T2.at[i:iq, :iq].set(T2[i:iq, :iq] - upd.astype(dt))
+            T = T2
+            tm.tick("update", k, T)
+            continue
+        # look-ahead: the next panel's column strip updates first (one tall
+        # narrow matmul), diag block k+1 factors + panel k+1 solves from it;
+        # the wide remainder stripes read only the pre-update T2, so the
+        # replicated _potrf_inv and the MXU stripes can overlap.
+        w2 = min(ib, mt)
+        strip = T2[:, :w2] - jnp.matmul(L21, jnp.conj(L21[:w2, :]).T,
+                                        precision=precision).astype(dt)
+        L11n, Li11n = _potrf_inv(strip[:w2, :w2], precision)
+        tm.tick("diag", k + 1, L11n)
+        L21n = None
+        if w2 < mt:
+            L21n = jnp.matmul(strip[w2:, :], jnp.conj(Li11n).T,
+                              precision=_hi(precision)).astype(dt)
+            tm.tick("panel", k + 1, L21n)
+        nxt = (L11n, Li11n, L21n)
+        T2 = T2.at[:, :w2].set(strip)
+        for i in range(w2, mt, q):
             iq = min(i + q, mt)
-            upd = jnp.matmul(L21[i:iq, :], jnp.conj(L21[:iq, :]).T,
+            upd = jnp.matmul(L21[i:iq, :], jnp.conj(L21[w2:iq, :]).T,
                              precision=precision)
-            T2 = T2.at[i:iq, :iq].set(T2[i:iq, :iq] - upd.astype(a.dtype))
+            T2 = T2.at[i:iq, w2:iq].set(T2[i:iq, w2:iq] - upd.astype(dt))
         T = T2
-    out = jnp.zeros((n, n), a.dtype)
+        tm.tick("update", k, T)
+    out = jnp.zeros((n, n), dt)
     s = 0
     for P in panels:
         out = lax.dynamic_update_slice(out, P, (s, s))
         s += P.shape[1]
+    return out
+
+
+def _local_cholesky(A: DistMatrix, nb: int | None, precision,
+                    lookahead: bool = True, timer=None) -> DistMatrix:
+    """Sequential (p == 1) lower path: the analog of the reference's local
+    ``Matrix<T>`` dispatch onto sequential BLAS.  On a 1x1 grid the storage
+    array IS the global matrix, so the whole blocked loop is one fused XLA
+    program with no shard_map/redistribute sub-computation boundaries."""
+    ib = max(nb or 2048, 1)
+    out = _local_chol_array(A.local, A.gshape[0], ib, precision,
+                            lookahead=lookahead, timer=timer)
     return make_trapezoidal(A.with_local(out), "L")
 
 
 def cholesky(A: DistMatrix, uplo: str = "L", nb: int | None = None,
-             precision=None) -> DistMatrix:
+             precision=None, lookahead: bool = True,
+             crossover: int | None = None, timer=None) -> DistMatrix:
     """Cholesky factor of an HPD [MC,MR] matrix; reads only the ``uplo``
-    triangle.  Returns L (A = L L^H) for 'L', U (A = U^H U) for 'U'."""
+    triangle.  Returns L (A = L L^H) for 'L', U (A = U^H U) for 'U'.
+
+    ``lookahead`` selects the pipelined schedule (module docstring; ``False``
+    restores the classic right-looking order, bit-identical factors);
+    ``crossover`` is the trailing-matrix size at which the distributed loop
+    gathers the tail once and finishes locally (``None`` = :data:`_CROSSOVER`
+    with look-ahead, disabled classic; 0 never crosses over); ``timer``
+    enables eager per-phase wall-clock attribution (``perf/phase_timer.py``).
+    """
     _check_mcmr(A)
     if uplo.upper().startswith("U"):
         # U = (lower factor of A^H-as-lower)^H; A hermitian so the data of
         # the upper triangle, conj-transposed, is the lower triangle.
         Alow = redistribute(transpose_dist(A, conj=True), MC, MR)
-        L = cholesky(Alow, "L", nb=nb, precision=precision)
+        L = cholesky(Alow, "L", nb=nb, precision=precision,
+                     lookahead=lookahead, crossover=crossover, timer=timer)
         return redistribute(transpose_dist(L, conj=True), MC, MR)
 
     m = A.gshape[0]
     if A.gshape != (m, m):
         raise ValueError(f"cholesky needs square, got {A.gshape}")
     g = A.grid
+    tm = timer if timer is not None else _NULL_TIMER
+    tm.start()
     if g.size == 1:
-        return _local_cholesky(A, nb, precision)
+        return _local_cholesky(A, nb, precision, lookahead, timer)
     r, c = g.height, g.width
     ib = _blocksize(nb, math.lcm(r, c), m)
+    xover = (_CROSSOVER if lookahead else 0) if crossover is None \
+        else max(int(crossover), 0)
     L = A
-    for s in range(0, m, ib):
-        e = min(s + ib, m)
-        A11 = redistribute(view(L, rows=(s, e), cols=(s, e)), STAR, STAR)
-        # replicated diagonal-block factor + inverse: every device runs the
-        # same deterministic _potrf_inv, so the panel Trsm below is a matmul
+    if lookahead:
+        # prologue: factor diag block 0 + solve panel 0 from the input
+        e0 = min(ib, m)
+        A11 = redistribute(view(L, rows=(0, e0), cols=(0, e0)), STAR, STAR)
         L11, Li11 = _potrf_inv(A11.local, precision)
+        tm.tick("diag", 0, L11)
+        L21_vc = None
+        if e0 < m:
+            A21_vc = redistribute(view(L, rows=(e0, m), cols=(0, e0)),
+                                  VC, STAR)
+            x21 = jnp.matmul(A21_vc.local, jnp.conj(Li11).T,
+                             precision=_hi(precision)).astype(L.dtype)
+            L21_vc = DistMatrix(x21, (m - e0, e0), VC, STAR, 0, 0, g)
+            tm.tick("panel", 0, L21_vc)
+        nxt = (L11, Li11, L21_vc)
+    for k, s in enumerate(range(0, m, ib)):
+        e = min(s + ib, m)
+        if lookahead:
+            L11, Li11, L21_vc = nxt
+        else:
+            A11 = redistribute(view(L, rows=(s, e), cols=(s, e)), STAR, STAR)
+            # replicated diagonal-block factor + inverse: every device runs
+            # the same deterministic _potrf_inv, so the panel Trsm below is
+            # a matmul
+            L11, Li11 = _potrf_inv(A11.local, precision)
+            tm.tick("diag", k, L11)
         L11_ss = DistMatrix(L11, (e - s, e - s), STAR, STAR, 0, 0, g)
         L = update_view(L, redistribute(L11_ss, MC, MR), rows=(s, e), cols=(s, e))
         if e == m:
             break
-        A21_vc = redistribute(view(L, rows=(e, m), cols=(s, e)), VC, STAR)
-        x21 = jnp.matmul(A21_vc.local, jnp.conj(Li11).T,
-                         precision=_hi(precision)).astype(L.dtype)  # A21 L11^{-H}
-        L21_vc = DistMatrix(x21, (m - e, e - s), VC, STAR, 0, 0, g)
-        L21_mc = redistribute(L21_vc, MC, STAR)
-        L21H_mr = redistribute(transpose_dist(L21_vc, conj=True), STAR, MR)
-        A22 = view(L, rows=(e, m), cols=(e, m))
-        upd = jnp.matmul(L21_mc.local, L21H_mr.local, precision=precision)
-        mask = _mask_triangle(A22, "L")
-        A22new = jnp.where(mask, A22.local - upd.astype(L.dtype), A22.local)
-        L = update_view(L, A22.with_local(A22new), rows=(e, m), cols=(e, m))
-        L = update_view(L, redistribute(L21_mc, MC, MR), rows=(e, m), cols=(s, e))
+        if not lookahead:
+            A21_vc = redistribute(view(L, rows=(e, m), cols=(s, e)), VC, STAR)
+            x21 = jnp.matmul(A21_vc.local, jnp.conj(Li11).T,
+                             precision=_hi(precision)).astype(L.dtype)  # A21 L11^{-H}
+            L21_vc = DistMatrix(x21, (m - e, e - s), VC, STAR, 0, 0, g)
+            tm.tick("panel", k, L21_vc)
+        L21_mc, L21H_mr = panel_spread(L21_vc, conj=True)
+        tm.tick("spread", k, L21_mc, L21H_mr)
+        tail = bool(xover) and m - e <= xover
+        if not lookahead:
+            A22 = view(L, rows=(e, m), cols=(e, m))
+            upd = jnp.matmul(L21_mc.local, L21H_mr.local, precision=precision)
+            mask = _mask_triangle(A22, "L")
+            A22new = jnp.where(mask, A22.local - upd.astype(L.dtype), A22.local)
+            L = update_view(L, A22.with_local(A22new), rows=(e, m), cols=(e, m))
+            L = update_view(L, redistribute(L21_mc, MC, MR), rows=(e, m), cols=(s, e))
+            tm.tick("update", k, L)
+        else:
+            # (a) narrow strip update: the next panel's columns of A22
+            e2 = min(e + ib, m)
+            A22a = view(L, rows=(e, m), cols=(e, e2))
+            L21H_a = view(L21H_mr, cols=(0, e2 - e))
+            maskA = _mask_triangle(A22a, "L")
+            stripD = A22a.with_local(jnp.where(
+                maskA,
+                A22a.local - jnp.matmul(L21_mc.local, L21H_a.local,
+                                        precision=precision).astype(L.dtype),
+                A22a.local))
+            if not tail:
+                # factor diag block k+1 + solve panel k+1 from the strip,
+                # off the critical path of the wide remainder update
+                A11n = redistribute(view(stripD, rows=(0, e2 - e),
+                                         cols=(0, e2 - e)), STAR, STAR)
+                L11n, Li11n = _potrf_inv(A11n.local, precision)
+                tm.tick("diag", k + 1, L11n)
+                L21n_vc = None
+                if e2 < m:
+                    A21n = redistribute(view(stripD, rows=(e2 - e, m - e),
+                                             cols=(0, e2 - e)), VC, STAR)
+                    x21n = jnp.matmul(A21n.local, jnp.conj(Li11n).T,
+                                      precision=_hi(precision)).astype(L.dtype)
+                    L21n_vc = DistMatrix(x21n, (m - e2, e2 - e), VC, STAR,
+                                         0, 0, g)
+                    tm.tick("panel", k + 1, L21n_vc)
+                nxt = (L11n, Li11n, L21n_vc)
+            # (b) wide remainder update; operands captured pre-writeback so
+            # it is data-independent of the step-k+1 factorization above
+            restD = None
+            if e2 < m:
+                A22b = view(L, rows=(e, m), cols=(e2, m))
+                L21H_b = view(L21H_mr, cols=(e2 - e, m - e))
+                I, J = _global_indices(A22b)
+                maskB = (J[None, :] + (e2 - e)) <= I[:, None]
+                restD = A22b.with_local(jnp.where(
+                    maskB,
+                    A22b.local - jnp.matmul(L21_mc.local, L21H_b.local,
+                                            precision=precision).astype(L.dtype),
+                    A22b.local))
+            L = update_view(L, redistribute(L21_mc, MC, MR), rows=(e, m), cols=(s, e))
+            L = update_view(L, stripD, rows=(e, m), cols=(e, e2))
+            if restD is not None:
+                L = update_view(L, restD, rows=(e, m), cols=(e2, m))
+            tm.tick("update", k, L)
+        if tail:
+            # crossover-to-local: one gather of the (fully updated) trailing
+            # block, replicated sequential finish, one scatter back -- the
+            # remaining t/nb steps of per-step collective latency collapse
+            # into a single round trip
+            Atail = redistribute(view(L, rows=(e, m), cols=(e, m)), STAR, STAR)
+            lt = _local_chol_array(Atail.local, m - e, ib, precision,
+                                   lookahead=lookahead)
+            Lt_ss = DistMatrix(lt, (m - e, m - e), STAR, STAR, 0, 0, g)
+            L = update_view(L, redistribute(Lt_ss, MC, MR),
+                            rows=(e, m), cols=(e, m))
+            tm.tick("tail", k, L)
+            break
     return make_trapezoidal(L, "L")
 
 

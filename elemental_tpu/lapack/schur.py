@@ -236,16 +236,19 @@ def pseudospectra(A: DistMatrix, re_window, im_window, nx: int = 20,
                   base: int | None = None, nb: int | None = None,
                   precision=None, seed: int = 0, tol: float = 1e-3,
                   check_every: int = 3, deflate: bool = True,
-                  snapshot=None):
+                  quiet_checks: int = 3, snapshot=None):
     """Inverse-norm map est. sigma_min(A - z I) over a 2-D shift window
     (``El::Pseudospectra``): Schur once, then batched inverse power
     iteration on (T - z I)^H (T - z I) through ``multishift_trsm``.
 
     Deflation (the ``Pseudospectra/{Power,Lanczos}.hpp`` machinery): every
     ``check_every`` sweeps, shifts whose estimate moved by less than
-    ``tol`` relatively are FROZEN and removed from the batch; the active
-    set repacks to the next power-of-two width, so XLA compiles at most
-    log2(k) shapes while converged shifts stop costing solves.  The
+    ``tol`` relatively for ``quiet_checks`` CONSECUTIVE checks are FROZEN
+    and removed from the batch (inverse iteration can plateau for a few
+    sweeps before converging toward a different value, so a single quiet
+    check is not convergence; any loud check resets the shift's counter);
+    the active set repacks to the next power-of-two width, so XLA compiles
+    at most log2(k) shapes while converged shifts stop costing solves.  The
     ``snapshot`` callable (``SnapshotCtrl`` analog) receives
     ``(sweep, Z, sigmin_so_far)`` after every check for progressive dumps.
 
@@ -276,6 +279,8 @@ def pseudospectra(A: DistMatrix, re_window, im_window, nx: int = 20,
     sh_act = all_shifts.copy()      # length ka, padded with repeats
     est_final = np.zeros(k)
     prev = np.full(k, np.inf)
+    quiet = np.zeros(k, dtype=int)      # consecutive quiet checks per shift
+    need = max(int(quiet_checks), 1)
     sweep = 0
 
     def one_sweep(V, shifts_dev, cshifts_dev, width):
@@ -306,7 +311,8 @@ def pseudospectra(A: DistMatrix, re_window, im_window, nx: int = 20,
         est_final[active] = estn
         rel = np.abs(estn - prev[active]) / np.maximum(np.abs(estn), 1e-300)
         prev[active] = estn
-        conv = rel < tol
+        quiet[active] = np.where(rel < tol, quiet[active] + 1, 0)
+        conv = quiet[active] >= need
         if snapshot is not None:
             part = np.where(np.isfinite(est_final) & (est_final > 0),
                             1.0 / np.maximum(est_final, 1e-300), 0.0)

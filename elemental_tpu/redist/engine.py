@@ -27,6 +27,7 @@ fall back otherwise.
 from __future__ import annotations
 
 import dataclasses
+from collections import Counter
 from functools import partial
 
 import jax
@@ -40,6 +41,16 @@ from ..core.dist import (
     stride as dist_stride, gather_axes, rank_of, md_slot_of_global,
 )
 from ..core.distmatrix import DistMatrix, _check_pair
+
+
+#: Trace-time instrumentation: public-entry call counts, keyed by
+#: ``(src_dist_pair, dst_dist_pair)`` for :func:`redistribute` and by the
+#: string ``"panel_spread"`` for :func:`panel_spread`.  Tests assert routing
+#: through it (e.g. that the cholesky/herk trailing chain takes the fused
+#: panel-spread path instead of three redistribute calls); clear between
+#: measurements with ``REDIST_COUNTS.clear()``.  Counts python-level entry
+#: calls, not executed collectives -- jit caching does not hide them.
+REDIST_COUNTS: Counter = Counter()
 
 
 # ---------------------------------------------------------------------
@@ -530,6 +541,68 @@ def _retag(A: DistMatrix, dim: int, d: Dist, loc) -> DistMatrix:
 
 
 # ---------------------------------------------------------------------
+# fused panel spread ([VC,STAR] -> the [MC,STAR]/[STAR,MR] operand pair)
+# ---------------------------------------------------------------------
+
+def _panel_spread_to_pair(A: DistMatrix, conj: bool):
+    """Inside shard_map: one (m, k) [VC,STAR] panel -> its [MC,STAR] spread
+    AND its [STAR,MR] adjoint, in ONE collective round.
+
+    A single all_gather over the flattened ('mr','mc') axis rebuilds the
+    full panel on every device; both outputs are then pure-local filters
+    (plus the free local transpose for the adjoint).  The separate-call
+    route costs three collective rounds: the [MC,STAR] partial gather, the
+    VC->VR ppermute and the VR->MR partial gather of the adjoint chain.
+    The panels here are thin (k = nb << m), so they are latency-bound and
+    one full-panel round beats three partial rounds despite moving
+    ~m*k instead of ~m*k*(1/r + 1/c) per device -- the collective-fusion
+    trade of the array-redistribution literature (PAPERS.md 2112.01075).
+    """
+    g = A.grid
+    r, c = g.height, g.width
+    m, k = A.gshape
+    full = _gather_dim(A.local, 0, VC, 0, m, r, c)        # replicated (m, k)
+    mc = _from_star_star(full, (m, k), MC, STAR, 0, 0, g)
+    adj = full.T
+    if conj:
+        adj = jnp.conj(adj)
+    mr = _from_star_star(adj, (k, m), STAR, MR, 0, 0, g)
+    return mc, mr
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _panel_spread_jit(A: DistMatrix, conj: bool):
+    g = A.grid
+    m, k = A.gshape
+    mc_meta = DistMatrix(None, (m, k), MC, STAR, 0, 0, g)
+    mr_meta = DistMatrix(None, (k, m), STAR, MR, 0, 0, g)
+
+    def f(a):
+        return _panel_spread_to_pair(a, conj)
+
+    return shard_map(
+        f, mesh=g.mesh, in_specs=(A.spec,),
+        out_specs=(mc_meta.spec, mr_meta.spec), check_vma=False,
+    )(A)
+
+
+def panel_spread(A: DistMatrix, conj: bool = True):
+    """``(A -> [MC,STAR],  op(A)^T -> [STAR,MR])`` for a zero-aligned
+    [VC,STAR] panel, fused into a single collective round.
+
+    The hot move of the Hermitian rank-k family: ``cholesky``'s trailing
+    update and ``herk``/``her2k``'s per-panel chain all need exactly this
+    operand pair for the ``LocalTrrk`` storage matmul.  ``conj=True``
+    (default) produces the conjugate-transposed adjoint (``A^H``);
+    ``conj=False`` the plain transpose (the ``syrk`` form)."""
+    if A.dist != (VC, STAR) or (A.calign, A.ralign) != (0, 0):
+        raise ValueError(f"panel_spread needs a zero-aligned [VC,STAR] "
+                         f"panel, got {A}")
+    REDIST_COUNTS["panel_spread"] += 1
+    return _panel_spread_jit(A, conj)
+
+
+# ---------------------------------------------------------------------
 # transpose-dist ([U,V] -> [V,U] with local transpose; free)
 # ---------------------------------------------------------------------
 
@@ -617,6 +690,7 @@ def redistribute(A: DistMatrix, cdist: Dist, rdist: Dist,
     global bridges plus cross-device ``device_put`` (copy::Gather /
     copy::Scatter) -- they cannot live inside jit/shard_map."""
     _check_pair(cdist, rdist)
+    REDIST_COUNTS[(A.dist, (cdist, rdist))] += 1
     if cdist is CIRC or A.cdist is CIRC:
         from ..core.distmatrix import from_global, to_global
         import jax.sharding as jsh

@@ -5,22 +5,27 @@ each timing with a matmul roofline measurement so chip-weather is factored
 out per-variant (the r4 lesson: never land a "perf" change without a
 before/after pair).  Usage:
 
-    python perf/ab_harness.py chol          # Cholesky variants at N=32768
+    python perf/ab_harness.py chol          # _potrf_inv variants at N=32768
     python perf/ab_harness.py lu [N]        # LU: classic vs look-ahead,
                                             #   nb + _INNERS sweep (dflt 16384)
-    python perf/ab_harness.py phases [N NB] # per-step panel/swap/solve/update
-                                            #   wall-clock as one JSON line
+    python perf/ab_harness.py cholesky [N]  # Cholesky: classic vs look-ahead
+                                            #   x nb x crossover (dflt 16384)
+    python perf/ab_harness.py phases [lu|cholesky] [N NB]
+                                            # per-step phase wall-clock as
+                                            #   one phase_timings/v1 JSON line
 
-``lu`` is the look-ahead A/B pair from ISSUE 1: the first two variants are
-the classic right-looking schedule and the pipelined look-ahead schedule at
-identical (nb, _INNERS), same process, roofline-bracketed; the rest sweep
-nb, the _INNERS chunk ladder, and the bf16 trailing-update knob
-(``update_precision=DEFAULT``, residual printed alongside).
+``lu`` is the look-ahead A/B pair from ISSUE 1; ``cholesky`` is ISSUE 2's:
+the first two variants are the classic right-looking schedule and the
+pipelined look-ahead schedule at identical nb, same process, roofline
+bracketed; the rest sweep nb and (on a multi-device grid, where the
+distributed loop runs) the tail crossover-to-local threshold.  The
+harness uses ALL visible devices -- on CPU export
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` to exercise the
+distributed schedule without hardware.
 
-``phases`` drives ``perf.phase_timer.PhaseTimer`` through the real ``lu``
-driver (eagerly, sync at each phase boundary) and emits the
-``phase_timings/v1`` JSON -- the hook future perf PRs use to attribute
-regressions.
+``phases`` drives ``perf.phase_timer.PhaseTimer`` through the real driver
+(eagerly, sync at each phase boundary) and emits the ``phase_timings/v1``
+JSON -- the hook future perf PRs use to attribute regressions.
 """
 import os
 import sys
@@ -203,25 +208,86 @@ def run_lu(n=None):
     lu_mod._INNERS = orig_inners
 
 
-def run_phases(n=None, nb=None):
-    """Per-step panel/swap/solve/update wall-clock through the REAL lu
-    driver (eager, PhaseTimer syncs at each boundary) -> one JSON line."""
-    from perf.phase_timer import PhaseTimer
+def run_cholesky(n=None):
+    """ISSUE 2 A/B: classic vs look-ahead x nb x tail-crossover, same
+    process and grid (all visible devices), roofline-bracketed.  On a
+    single device the crossover rows are skipped (the sequential path has
+    no redistribution tail to cross over from)."""
     on_tpu = jax.devices()[0].platform != "cpu"
     n = int(n) if n else (16384 if on_tpu else 512)
-    nb = int(nb) if nb else (2048 if on_tpu else 128)
+    grid = el.Grid(jax.devices())
+    p = grid.size
+    nb0 = 2048 if on_tpu else 128
+
+    @jax.jit
+    def gen():
+        G = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.float32)
+        return jnp.matmul(G, G.T) / n + n * jnp.eye(n, dtype=jnp.float32)
+
+    def wrap(a):
+        return el.DistMatrix(a, (n, n), el.MC, el.MR, 0, 0, grid)
+
+    # (name, lookahead, nb, crossover)
+    cases = [
+        (f"classic        nb={nb0} xover=0", False, nb0, 0),
+        (f"look-ahead     nb={nb0} xover=0", True, nb0, 0),
+        (f"look-ahead     nb={nb0 // 2} xover=0", True, nb0 // 2, 0),
+        (f"look-ahead     nb={nb0 * 2} xover=0", True, nb0 * 2, 0),
+    ]
+    if p > 1:
+        for xo in (n // 8, n // 4, n // 2):
+            cases.append((f"look-ahead     nb={nb0} xover={xo}", True, nb0, xo))
+        cases.append((f"classic        nb={nb0} xover={n // 4}",
+                      False, nb0, n // 4))
+    print(f"grid {grid.height}x{grid.width}, n={n}", flush=True)
+    for name, la, nb, xo in cases:
+        step = jax.jit(
+            lambda a, _nb=nb, _la=la, _xo=xo: el.cholesky(
+                a, nb=_nb, precision=HI, lookahead=_la, crossover=_xo).local,
+            donate_argnums=0)
+        r0 = roofline()
+        dt = timed(lambda: wrap(gen()), step)
+        r1 = roofline()
+        report(name, (n ** 3 / 3) / dt / 1e12, 0.5 * (r0 + r1))
+        del step
+
+
+def run_phases(*args):
+    """Per-step phase wall-clock through the REAL driver (eager, PhaseTimer
+    syncs at each boundary) -> one phase_timings/v1 JSON line.
+    ``phases [lu|cholesky] [N NB]`` (driver defaults to lu)."""
+    from perf.phase_timer import PhaseTimer
+    args = list(args)
+    driver = "lu"
+    if args and not args[0].isdigit():
+        driver = args.pop(0)
+    n = int(args[0]) if args else None
+    nb = int(args[1]) if len(args) > 1 else None
+    on_tpu = jax.devices()[0].platform != "cpu"
+    n = n or (16384 if on_tpu else 512)
+    nb = nb or (2048 if on_tpu else 128)
     grid = el.Grid([jax.devices()[0]])
-    a = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.float32)
-    A = el.DistMatrix(a, (n, n), el.MC, el.MR, 0, 0, grid)
-    jax.block_until_ready(a)
     t = PhaseTimer()
-    LU, perm = el.lu(A, nb=nb, precision=HI, lookahead=True, timer=t)
-    jax.block_until_ready((LU.local, perm))
+    if driver == "cholesky":
+        G = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.float32)
+        a = jnp.matmul(G, G.T) / n + n * jnp.eye(n, dtype=jnp.float32)
+        A = el.DistMatrix(a, (n, n), el.MC, el.MR, 0, 0, grid)
+        jax.block_until_ready(a)
+        L = el.cholesky(A, nb=nb, precision=HI, lookahead=True, timer=t)
+        jax.block_until_ready(L.local)
+        meta = dict(driver="cholesky", flops=n ** 3 / 3,
+                    crossover=chol_mod._CROSSOVER)
+    else:
+        a = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.float32)
+        A = el.DistMatrix(a, (n, n), el.MC, el.MR, 0, 0, grid)
+        jax.block_until_ready(a)
+        LU, perm = el.lu(A, nb=nb, precision=HI, lookahead=True, timer=t)
+        jax.block_until_ready((LU.local, perm))
+        meta = dict(driver="lu", flops=2 * n ** 3 / 3,
+                    inners=list(lu_mod._INNERS))
     r = roofline()
-    print(t.json(driver="lu", n=n, nb=nb, lookahead=True,
-                 inners=list(lu_mod._INNERS),
-                 flops=2 * n ** 3 / 3, roofline_tflops=round(r, 2),
-                 device=jax.devices()[0].device_kind), flush=True)
+    print(t.json(n=n, nb=nb, lookahead=True, roofline_tflops=round(r, 2),
+                 device=jax.devices()[0].device_kind, **meta), flush=True)
 
 
 if __name__ == "__main__":
@@ -237,5 +303,7 @@ if __name__ == "__main__":
         run_chol()
     elif mode == "lu":
         run_lu(*sys.argv[2:3])
+    elif mode == "cholesky":
+        run_cholesky(*sys.argv[2:3])
     else:
-        run_phases(*sys.argv[2:4])
+        run_phases(*sys.argv[2:5])

@@ -1,7 +1,8 @@
 """Reusable per-phase wall-clock attribution for blocked drivers.
 
-The observability half of the look-ahead LU work (ISSUE 1): any driver that
-accepts a ``timer`` argument (today ``lapack.lu.lu`` / ``_local_lu``) calls
+The observability half of the look-ahead LU/Cholesky work (ISSUEs 1-2): any
+driver that accepts a ``timer`` argument (today ``lapack.lu.lu`` and
+``lapack.cholesky.cholesky``, both grid and sequential paths) calls
 ``timer.tick(phase, step, *arrays)`` at its phase boundaries.  The timer
 synchronizes on the phase's outputs (``jax.block_until_ready``) and charges
 the elapsed wall-clock since the previous tick to ``(phase, step)``, so a
@@ -16,9 +17,11 @@ make the ticks no-ops on tracers):
     LU, perm = el.lu(A, nb=2048, timer=t)
     print(t.json(driver="lu", n=n, nb=2048))
 
-``python perf/ab_harness.py phases`` is the CLI wrapper; the JSON schema is
-pinned by ``tests/perf/test_phase_smoke.py`` so the observability path
-cannot silently rot.  Schema (``phase_timings/v1``)::
+``python perf/ab_harness.py phases [lu|cholesky]`` is the CLI wrapper; the
+JSON schema is pinned by ``tests/perf/test_phase_smoke.py`` so the
+observability path cannot silently rot.  Schema (``phase_timings/v1``;
+LU emits panel/swap/solve/update, Cholesky diag/panel/spread/update and
+``tail`` on the crossover step)::
 
     {"schema": "phase_timings/v1",
      "steps":  [{"step": 0, "panel": s, "swap": s, "solve": s, "update": s},
@@ -41,8 +44,9 @@ import jax
 
 SCHEMA = "phase_timings/v1"
 
-#: canonical phase order for reports (drivers may emit a subset)
-PHASES = ("panel", "swap", "solve", "update")
+#: canonical phase order for reports (drivers emit a subset: LU ticks
+#: panel/swap/solve/update, Cholesky diag/panel/spread/update + tail)
+PHASES = ("diag", "panel", "swap", "solve", "spread", "update", "tail")
 
 
 class PhaseTimer:

@@ -52,6 +52,75 @@ def test_gemm_alpha_beta(grid24):
                                rtol=1e-12)
 
 
+def test_gemm_dot_complex_beta(grid24):
+    """alg='dot' honors a complex beta against the oracle (the [STAR,VC]
+    contraction path used to be the only one without coverage here)."""
+    rng = _rng(41)
+    m, k, n = 6, 40, 5
+    A = rng.normal(size=(m, k)) + 1j * rng.normal(size=(m, k))
+    B = rng.normal(size=(k, n)) + 1j * rng.normal(size=(k, n))
+    C0 = rng.normal(size=(m, n)) + 1j * rng.normal(size=(m, n))
+    alpha, beta = 1.5 - 0.5j, 0.7 - 0.3j
+    out = l3.gemm(_dist(grid24, A), _dist(grid24, B), alpha=alpha, beta=beta,
+                  C=_dist(grid24, C0), alg="dot")
+    np.testing.assert_allclose(np.asarray(to_global(out)),
+                               alpha * A @ B + beta * C0, rtol=1e-12)
+
+
+def test_gemm_dot_complex_zero_beta_real_c(grid24):
+    """beta=0j on a REAL C must behave as beta=0 (no complex accumulator
+    forced through _safe_astype)."""
+    rng = _rng(42)
+    m, k, n = 6, 40, 5
+    A, B = rng.normal(size=(m, k)), rng.normal(size=(k, n))
+    C0 = rng.normal(size=(m, n))
+    out = l3.gemm(_dist(grid24, A), _dist(grid24, B), beta=0j,
+                  C=_dist(grid24, C0), alg="dot")
+    np.testing.assert_allclose(np.asarray(to_global(out)), A @ B, rtol=1e-12)
+
+
+def test_gemm_dot_p1_early_out():
+    """On a 1x1 grid alg='dot' multiplies the storage arrays directly --
+    zero redistribute calls (pinned via the engine's call counts)."""
+    import jax
+    from elemental_tpu import Grid
+    from elemental_tpu.redist import engine
+
+    g1 = Grid([jax.devices()[0]])
+    rng = _rng(43)
+    m, k, n = 6, 40, 5
+    A, B = rng.normal(size=(m, k)), rng.normal(size=(k, n))
+    C0 = rng.normal(size=(m, n))
+    Ad, Bd, Cd = _dist(g1, A), _dist(g1, B), _dist(g1, C0)
+    engine.REDIST_COUNTS.clear()
+    out = l3.gemm(Ad, Bd, alpha=2.0, beta=-0.5, C=Cd, alg="dot")
+    assert not engine.REDIST_COUNTS, dict(engine.REDIST_COUNTS)
+    np.testing.assert_allclose(np.asarray(to_global(out)),
+                               2.0 * A @ B - 0.5 * C0, rtol=1e-12)
+
+
+def test_herk_uses_fused_panel_spread(grid24):
+    """The herk per-panel [MC,STAR]/[STAR,MR] pair must ride the fused
+    panel_spread (one collective round), not the three-redistribute chain."""
+    from elemental_tpu import VC
+    from elemental_tpu.redist import engine
+
+    rng = _rng(44)
+    n, k, nb = 12, 16, 8
+    A = rng.normal(size=(n, k))
+    Ad = _dist(grid24, A)
+    engine.REDIST_COUNTS.clear()
+    C = l3.herk("L", Ad, nb=nb)
+    counts = dict(engine.REDIST_COUNTS)
+    npanels = -(-k // nb)
+    assert counts.get("panel_spread") == npanels
+    assert counts.get(((MC, MR), (VC, STAR))) == npanels
+    assert ((VC, STAR), (MC, STAR)) not in counts
+    assert ((STAR, VC), (STAR, MR)) not in counts
+    got = np.asarray(to_global(C))
+    np.testing.assert_allclose(np.tril(got), np.tril(A @ A.T), rtol=1e-12)
+
+
 def test_gemm_two_grids(two_grids):
     rng = _rng(4)
     m, k, n = 13, 21, 8

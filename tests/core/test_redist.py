@@ -70,6 +70,39 @@ def test_transpose_dist(grid24):
     np.testing.assert_array_equal(np.asarray(to_global(out_meta)), F.T)
 
 
+@pytest.mark.parametrize("conj", [True, False])
+@pytest.mark.parametrize("shape", [(24, 8), (19, 5)])
+def test_panel_spread_matches_separate_redists(any_grid, shape, conj):
+    """The fused one-collective panel spread must produce bitwise the same
+    [MC,STAR] / [STAR,MR]-adjoint locals as the three-redistribute route it
+    replaces, on every grid shape incl. ragged extents."""
+    from elemental_tpu import MC, MR, VC, STAR, panel_spread
+
+    m, k = shape
+    rng = np.random.default_rng(31)
+    F = rng.normal(size=(m, k)) + 1j * rng.normal(size=(m, k))
+    A_vc = redistribute(from_global(F, MC, MR, grid=any_grid), VC, STAR)
+    mc, mrH = panel_spread(A_vc, conj=conj)
+    assert mc.dist == (MC, STAR) and mrH.dist == (STAR, MR)
+    assert mc.gshape == (m, k) and mrH.gshape == (k, m)
+    mc_ref = redistribute(A_vc, MC, STAR)
+    mr_ref = redistribute(transpose_dist(A_vc, conj=conj), STAR, MR)
+    np.testing.assert_array_equal(np.asarray(mc.local),
+                                  np.asarray(mc_ref.local))
+    np.testing.assert_array_equal(np.asarray(mrH.local),
+                                  np.asarray(mr_ref.local))
+    want = np.conj(F.T) if conj else F.T
+    np.testing.assert_array_equal(np.asarray(to_global(mrH)), want)
+
+
+def test_panel_spread_rejects_wrong_dist(grid24):
+    from elemental_tpu import MC, MR, panel_spread
+
+    A = from_global(f(8, 4), MC, MR, grid=grid24)
+    with pytest.raises(ValueError):
+        panel_spread(A)
+
+
 def test_contract_mc_star(grid24):
     """Partial [MC,STAR] summed over MR comm lands on [MC,MR]."""
     import jax

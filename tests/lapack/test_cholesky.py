@@ -70,6 +70,110 @@ def test_cholesky_solve_after(grid24):
     assert np.linalg.norm(F @ np.asarray(to_global(X)) - B) < 1e-11 * np.linalg.norm(B)
 
 
+def _grid22():
+    import jax
+    return el.Grid(jax.devices()[:4], height=2)
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_cholesky_upper_multigrid(two_grids, dtype):
+    """uplo='U' across the generic + degenerate grid sweep (the adjoint
+    round-trip exercises the transpose-exchange chains per grid shape)."""
+    n = 21
+    A = hermitian_uniform_spectrum(n, 1, 9, two_grids, dtype=dtype, seed=13)
+    F = np.asarray(to_global(A))
+    U = np.asarray(to_global(el.cholesky(A, uplo="U", nb=8)))
+    assert np.allclose(np.tril(U, -1), 0)
+    assert np.linalg.norm(F - U.conj().T @ U) / np.linalg.norm(F) < 1e-13
+
+
+def test_cholesky_upper_2x2_grid():
+    n = 24
+    g = _grid22()
+    A = hermitian_uniform_spectrum(n, 1, 10, g, dtype=np.complex128, seed=14)
+    F = np.asarray(to_global(A))
+    U = np.asarray(to_global(el.cholesky(A, uplo="U", nb=8)))
+    assert np.allclose(np.tril(U, -1), 0)
+    assert np.linalg.norm(F - U.conj().T @ U) / np.linalg.norm(F) < 1e-13
+
+
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_hpd_solve_2x2_grid(uplo):
+    n, nrhs = 20, 5
+    g = _grid22()
+    A = hermitian_uniform_spectrum(n, 1, 8, g, dtype=np.float64, seed=15)
+    F = np.asarray(to_global(A))
+    B = np.random.default_rng(16).normal(size=(n, nrhs))
+    X = el.hpd_solve(A, from_global(B, MC, MR, g), uplo=uplo, nb=8)
+    assert np.linalg.norm(F @ np.asarray(to_global(X)) - B) \
+        < 1e-12 * np.linalg.norm(B)
+
+
+@pytest.mark.parametrize("n,dtype", [(24, np.float64), (19, np.complex128)])
+def test_cholesky_lookahead_matches_classic(grid24, n, dtype):
+    """The pipelined schedule reorders ops but computes the same update
+    matmuls element-for-element: factors must agree with the classic
+    right-looking driver to roundoff (crossover disabled so both run the
+    full distributed loop)."""
+    A = hermitian_uniform_spectrum(n, 1, 10, grid24, dtype=dtype, seed=17)
+    La = el.cholesky(A, nb=8, lookahead=True, crossover=0)
+    Lb = el.cholesky(A, nb=8, lookahead=False)
+    np.testing.assert_allclose(np.asarray(to_global(La)),
+                               np.asarray(to_global(Lb)),
+                               rtol=1e-12, atol=1e-13)
+
+
+def test_cholesky_lookahead_matches_classic_local():
+    """Same agreement on the sequential (1x1 grid) fast path."""
+    import jax
+    g1 = el.Grid([jax.devices()[0]])
+    for n in (40, 37):
+        A = hermitian_uniform_spectrum(n, 1, 10, g1, dtype=np.float64,
+                                       seed=18)
+        La = el.cholesky(A, nb=16, lookahead=True)
+        Lb = el.cholesky(A, nb=16, lookahead=False)
+        np.testing.assert_allclose(np.asarray(La.local),
+                                   np.asarray(Lb.local),
+                                   rtol=1e-12, atol=1e-13)
+
+
+def test_cholesky_crossover_boundary(grid24):
+    """Tail crossover at thresholds just below / at / above the remaining
+    trailing sizes (n=24, nb=8 leaves tails of 16 then 8): every setting
+    must agree with the never-crossing classic factor to roundoff."""
+    n = 24
+    A = hermitian_uniform_spectrum(n, 1, 10, grid24, dtype=np.float64,
+                                   seed=19)
+    F = np.asarray(to_global(A))
+    ref = np.asarray(to_global(el.cholesky(A, nb=8, lookahead=False)))
+    for xo in (7, 8, 16, n):
+        L = np.asarray(to_global(el.cholesky(A, nb=8, crossover=xo)))
+        np.testing.assert_allclose(L, ref, rtol=1e-12, atol=1e-13)
+        assert np.linalg.norm(F - L @ L.T) / np.linalg.norm(F) < 1e-13
+
+
+@pytest.mark.parametrize("lookahead", [True, False])
+def test_cholesky_panel_chain_uses_fused_spread(grid24, lookahead):
+    """The [MC,STAR]/[STAR,MR] trailing-update pair must come from the ONE
+    collective panel_spread fast path -- not from the three-redistribute
+    chain it replaced (pinned via the engine's trace-time call counts)."""
+    from elemental_tpu.redist import engine
+    from elemental_tpu import VC, STAR, MR
+    n, nb = 32, 8
+    A = hermitian_uniform_spectrum(n, 1, 10, grid24, dtype=np.float64,
+                                   seed=20)
+    F = np.asarray(to_global(A))
+    engine.REDIST_COUNTS.clear()
+    L = el.cholesky(A, nb=nb, lookahead=lookahead, crossover=0)
+    counts = dict(engine.REDIST_COUNTS)
+    npanels = n // nb
+    assert counts.get("panel_spread") == npanels - 1
+    assert ((VC, STAR), (MC, STAR)) not in counts
+    assert ((STAR, VC), (STAR, MR)) not in counts
+    Lh = np.asarray(to_global(L))
+    assert np.linalg.norm(F - Lh @ Lh.T) / np.linalg.norm(F) < 1e-13
+
+
 def test_matrix_gallery(grid24):
     from elemental_tpu.matrices import identity, ones, hilbert, lehmer, minij
     n = 11

@@ -104,6 +104,29 @@ def test_pseudospectra_map(grid24):
     assert np.max(np.abs(sm - direct) / np.maximum(direct, 1e-12)) < 1e-3
 
 
+def test_pseudospectra_quiet_checks_gate_deflation(grid24):
+    """A shift quiet on ONE check is a plateau, not convergence: with every
+    check quiet (huge tol), quiet_checks=K must keep the whole batch alive
+    for K consecutive checks before freezing it (pinned via the per-check
+    snapshot hook), instead of deflating everything at the first check."""
+    rng = np.random.default_rng(12)
+    n = 16
+    F = rng.normal(size=(n, n))
+    A = _dm(F, grid24)
+
+    def run(K):
+        checks = []
+        el.pseudospectra(A, (-2, 2), (-2, 2), nx=3, ny=2, iters=30,
+                         tol=1e30, check_every=2, quiet_checks=K,
+                         snapshot=lambda it, Z, S: checks.append(it))
+        return checks
+
+    # check 1 is always loud (prev = inf: a plateau needs two estimates),
+    # so K quiet checks freeze the batch at check K+1
+    assert run(1) == [2, 4]
+    assert run(3) == [2, 4, 6, 8]
+
+
 def test_pseudospectra_deflation_matches(grid24):
     """Deflated and non-deflated runs agree; snapshots fire (the
     SnapshotCtrl analog)."""

@@ -1,8 +1,9 @@
-"""Perf-observability smoke: a tiny LU through the phase-timing hook.
+"""Perf-observability smoke: tiny LU/Cholesky through the phase-timing hook.
 
-Slow-tier guard for the ``perf/phase_timer.py`` + ``lu(..., timer=...)``
-path (ISSUE 1 CI satellite): asserts the ``phase_timings/v1`` JSON schema
-so the attribution tooling future perf PRs rely on cannot silently rot.
+Slow-tier guard for the ``perf/phase_timer.py`` + ``lu/cholesky(...,
+timer=...)`` paths (ISSUE 1/2 CI satellites): asserts the
+``phase_timings/v1`` JSON schema so the attribution tooling future perf
+PRs rely on cannot silently rot.
 """
 import json
 
@@ -65,3 +66,78 @@ def test_lu_phase_timer_schema_local():
     LU, perm = el.lu(A, nb=nb, timer=t)
     doc = json.loads(t.json(driver="lu", n=n, nb=nb))
     _check_schema(doc, n, nb, nsteps=n // nb)
+
+
+def _spd(n, seed):
+    rng = np.random.default_rng(seed)
+    G = rng.normal(size=(n, n))
+    return G @ G.T / n + n * np.eye(n)
+
+
+def _check_chol_schema(doc, n, nb, nsteps, tail=False):
+    from perf.phase_timer import SCHEMA, PHASES
+    assert doc["schema"] == SCHEMA
+    assert doc["driver"] == "cholesky"
+    assert doc["n"] == n and doc["nb"] == nb
+    steps = doc["steps"]
+    assert [s["step"] for s in steps] == list(range(nsteps))
+    for srec in steps:
+        phases = set(srec) - {"step"}
+        assert phases <= set(PHASES)
+        assert "diag" in phases
+        for p in phases:
+            assert isinstance(srec[p], float) and srec[p] >= 0.0
+    totals = doc["totals"]
+    assert set(totals) <= set(PHASES) and "diag" in totals
+    assert ("tail" in totals) == tail
+    assert doc["total_seconds"] >= sum(totals.values()) - 1e-9
+    json.dumps(doc)          # round-trippable
+
+
+@pytest.mark.parametrize("lookahead", [True, False])
+def test_cholesky_phase_timer_schema_distributed(grid24, lookahead):
+    from perf.phase_timer import PhaseTimer
+    n, nb = 48, 16
+    F = _spd(n, 2)
+    A = el.from_global(F, el.MC, el.MR, grid=grid24)
+    t = PhaseTimer()
+    L = el.cholesky(A, nb=nb, lookahead=lookahead, crossover=0, timer=t)
+    doc = json.loads(t.json(driver="cholesky", n=n, nb=nb,
+                            lookahead=lookahead))
+    _check_chol_schema(doc, n, nb, nsteps=n // nb)
+    # non-final steps must also carry the panel/spread/update phases
+    for srec in doc["steps"][:-1]:
+        assert {"panel", "spread", "update"} <= set(srec)
+    # the timed run is still a correct factorization
+    Lh = np.asarray(el.to_global(L))
+    assert np.linalg.norm(F - Lh @ Lh.T) < 1e-11 * np.linalg.norm(F)
+
+
+def test_cholesky_phase_timer_tail_crossover(grid24):
+    """The crossover step attributes its gathered local finish to 'tail'."""
+    from perf.phase_timer import PhaseTimer
+    n, nb = 48, 16
+    F = _spd(n, 3)
+    A = el.from_global(F, el.MC, el.MR, grid=grid24)
+    t = PhaseTimer()
+    L = el.cholesky(A, nb=nb, crossover=nb, timer=t)
+    doc = json.loads(t.json(driver="cholesky", n=n, nb=nb))
+    # steps 0 and 1 run distributed; the 16-wide tail crosses over at step 1
+    _check_chol_schema(doc, n, nb, nsteps=2, tail=True)
+    assert "tail" in doc["steps"][-1]
+    Lh = np.asarray(el.to_global(L))
+    assert np.linalg.norm(F - Lh @ Lh.T) < 1e-11 * np.linalg.norm(F)
+
+
+def test_cholesky_phase_timer_schema_local():
+    """Same schema off the sequential (1x1-grid) driver."""
+    import jax
+    from perf.phase_timer import PhaseTimer
+    g1 = el.Grid([jax.devices()[0]])
+    n, nb = 64, 16
+    F = _spd(n, 4)
+    A = el.from_global(F, el.MC, el.MR, grid=g1)
+    t = PhaseTimer()
+    L = el.cholesky(A, nb=nb, timer=t)
+    doc = json.loads(t.json(driver="cholesky", n=n, nb=nb))
+    _check_chol_schema(doc, n, nb, nsteps=n // nb)
