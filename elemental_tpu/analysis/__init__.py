@@ -1,0 +1,27 @@
+"""Static comm-plan analysis (ISSUE 3).
+
+Trace-time extraction of every driver's collective schedule straight from
+the closed jaxpr -- no device execution -- plus a rule-based linter and
+the ``comm_plan/v1`` golden-snapshot machinery.  CLI:
+``python -m perf.comm_audit {audit,diff,lint} ...``; generalizes the
+Python-call-level ``REDIST_COUNTS`` to "what does the traced program
+actually do".
+"""
+from .jaxpr_walk import (CollectiveEvent, COLLECTIVE_PRIMS, collect_events,
+                         count_pjit_calls, estimate_bytes,
+                         find_loop_invariant_collectives)
+from .plan import SCHEMA, CommPlan, plan_from_parts, golden_doc, diff_docs
+from .lint import LintFinding, lint_plan
+from .drivers import (DRIVERS, LOOKAHEAD_PAIRS, DEFAULT_N, DEFAULT_NB,
+                      DEFAULT_XOVER, driver_names, trace_driver,
+                      trace_callable, storage_shape)
+
+__all__ = [
+    "CollectiveEvent", "COLLECTIVE_PRIMS", "collect_events",
+    "count_pjit_calls", "estimate_bytes", "find_loop_invariant_collectives",
+    "SCHEMA", "CommPlan", "plan_from_parts", "golden_doc", "diff_docs",
+    "LintFinding", "lint_plan",
+    "DRIVERS", "LOOKAHEAD_PAIRS", "DEFAULT_N", "DEFAULT_NB",
+    "DEFAULT_XOVER", "driver_names", "trace_driver", "trace_callable",
+    "storage_shape",
+]

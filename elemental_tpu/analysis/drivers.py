@@ -1,0 +1,215 @@
+"""Traceable driver registry + the abstract trace entry point.
+
+``trace_driver(name, grid, ...)`` builds storage-form abstract inputs for
+a registered distributed driver, traces it with ``jax.make_jaxpr`` (no
+device execution -- works under ``JAX_PLATFORMS=cpu``), and returns the
+extracted :class:`~elemental_tpu.analysis.plan.CommPlan` together with
+the closed jaxpr and the engine's redistribution log.
+
+Registered drivers (ISSUE 3's golden set): ``gemm`` under every explicit
+algorithm, ``trsm``, ``herk``, ``cholesky`` classic / look-ahead /
+explicit-crossover, ``lu`` classic / look-ahead / explicit-crossover, and
+``qr``.  Inputs default to float32 (n=64, nb=16) so the f64-promotion
+lint (EL004) has teeth on the goldens.
+
+Input construction note: inputs are built directly in stacked-storage
+form (``DistMatrix(storage, ...)``) from ``ShapeDtypeStruct`` specs --
+the ``from_global`` bridge would ``device_put`` eagerly and break the
+pure-abstract trace.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ..core import indexing as ix
+from ..core.dist import Dist, storage_slots, stride as dist_stride
+from ..core.distmatrix import DistMatrix
+from ..core.grid import Grid
+from ..redist.engine import redist_trace, redist_counts
+from .jaxpr_walk import collect_events
+from .plan import plan_from_parts
+
+MC, MR = Dist.MC, Dist.MR
+
+#: default trace geometry (4 blocked steps at 64/16; small enough that a
+#: full registry sweep traces in seconds, large enough that look-ahead,
+#: crossover, and the SUMMA panel loops all take their real schedules)
+DEFAULT_N = 64
+DEFAULT_NB = 16
+#: explicit mid-range crossover for the *_crossover variants: at n=64 the
+#: tail triggers after two distributed steps (64-32 <= 32), so the plan
+#: shows pipelined steps AND the tail collapse in one snapshot
+DEFAULT_XOVER = 32
+
+
+def storage_shape(m: int, n: int, cdist: Dist, rdist: Dist, grid: Grid):
+    """Stacked-storage array shape of a DistMatrix (outside shard_map)."""
+    r, c = grid.height, grid.width
+    lr = ix.max_local_length(m, dist_stride(cdist, r, c))
+    lc = ix.max_local_length(n, dist_stride(rdist, r, c))
+    return (storage_slots(cdist, r, c) * lr, storage_slots(rdist, r, c) * lc)
+
+
+def _mcmr_input(grid, m, n, dtype):
+    return jax.ShapeDtypeStruct(storage_shape(m, n, MC, MR, grid), dtype)
+
+
+def _as_dm(a, grid, m, n):
+    return DistMatrix(a, (m, n), MC, MR, 0, 0, grid)
+
+
+@dataclasses.dataclass(frozen=True)
+class DriverSpec:
+    """One registry entry: builds the traced callable + abstract inputs."""
+    name: str
+    build: callable          # (grid, n, nb, dtype) -> (fn, args, meta)
+    allow_bf16: bool = False
+
+
+def _gemm_spec(alg):
+    def build(grid, n, nb, dtype):
+        from ..blas.level3 import gemm
+
+        def fn(a, b):
+            A = _as_dm(a, grid, n, n)
+            B = _as_dm(b, grid, n, n)
+            return gemm(A, B, alg=alg, nb=nb)
+        args = (_mcmr_input(grid, n, n, dtype), _mcmr_input(grid, n, n, dtype))
+        return fn, args, {"alg": alg}
+    return DriverSpec(f"gemm_{alg.lower()}", build)
+
+
+def _trsm_spec():
+    def build(grid, n, nb, dtype):
+        from ..blas.level3 import trsm
+
+        def fn(a, b):
+            A = _as_dm(a, grid, n, n)
+            B = _as_dm(b, grid, n, n)
+            return trsm("L", "L", "N", A, B, nb=nb)
+        args = (_mcmr_input(grid, n, n, dtype), _mcmr_input(grid, n, n, dtype))
+        return fn, args, {}
+    return DriverSpec("trsm", build)
+
+
+def _herk_spec():
+    def build(grid, n, nb, dtype):
+        from ..blas.level3 import herk
+
+        def fn(a):
+            return herk("L", _as_dm(a, grid, n, n), nb=nb)
+        return fn, (_mcmr_input(grid, n, n, dtype),), {}
+    return DriverSpec("herk", build)
+
+
+def _cholesky_spec(variant, lookahead, crossover):
+    def build(grid, n, nb, dtype):
+        from ..lapack.cholesky import cholesky
+
+        def fn(a):
+            return cholesky(_as_dm(a, grid, n, n), nb=nb,
+                            lookahead=lookahead, crossover=crossover)
+        meta = {"lookahead": lookahead, "crossover": crossover}
+        return fn, (_mcmr_input(grid, n, n, dtype),), meta
+    return DriverSpec(f"cholesky_{variant}", build)
+
+
+def _lu_spec(variant, lookahead, crossover):
+    def build(grid, n, nb, dtype):
+        from ..lapack.lu import lu
+
+        def fn(a):
+            return lu(_as_dm(a, grid, n, n), nb=nb,
+                      lookahead=lookahead, crossover=crossover)
+        meta = {"lookahead": lookahead, "crossover": crossover}
+        return fn, (_mcmr_input(grid, n, n, dtype),), meta
+    return DriverSpec(f"lu_{variant}", build)
+
+
+def _qr_spec():
+    def build(grid, n, nb, dtype):
+        from ..lapack.qr import qr
+
+        def fn(a):
+            return qr(_as_dm(a, grid, n, n), nb=nb)
+        return fn, (_mcmr_input(grid, n, n, dtype),), {}
+    return DriverSpec("qr", build)
+
+
+def _registry() -> dict:
+    specs = [
+        _gemm_spec("A"), _gemm_spec("B"), _gemm_spec("C"),
+        _gemm_spec("dot"), _gemm_spec("gspmd"),
+        _trsm_spec(),
+        _herk_spec(),
+        # classic = right-looking baseline; lookahead = pure pipeline
+        # (crossover disabled); crossover = pipeline + tail collapse
+        _cholesky_spec("classic", lookahead=False, crossover=0),
+        _cholesky_spec("lookahead", lookahead=True, crossover=0),
+        _cholesky_spec("crossover", lookahead=True, crossover=DEFAULT_XOVER),
+        _lu_spec("classic", lookahead=False, crossover=0),
+        _lu_spec("lookahead", lookahead=True, crossover=0),
+        _lu_spec("crossover", lookahead=True, crossover=DEFAULT_XOVER),
+        _qr_spec(),
+    ]
+    return {s.name: s for s in specs}
+
+
+DRIVERS = _registry()
+
+#: look-ahead/classic pairs at EQUAL n/nb whose all_gather rounds the
+#: golden tests compare: the default look-ahead configuration (crossover
+#: tail enabled) must issue STRICTLY FEWER rounds than classic -- the
+#: jaxpr-level pin of the PR 1-2 fusions.
+LOOKAHEAD_PAIRS = (
+    ("cholesky_crossover", "cholesky_classic"),
+    ("lu_crossover", "lu_classic"),
+)
+
+
+def driver_names() -> list:
+    return sorted(DRIVERS)
+
+
+def trace_driver(name: str, grid: Grid, n: int = DEFAULT_N,
+                 nb: int = DEFAULT_NB, dtype=jnp.float32):
+    """Abstractly trace a registered driver; return
+    ``(CommPlan, closed_jaxpr, redist_log)``.
+
+    Pure trace: no device buffers are created and nothing executes, so
+    this runs identically under ``JAX_PLATFORMS=cpu`` on any host.  The
+    grid's devices only parameterize the mesh metadata.
+    """
+    spec = DRIVERS.get(name)
+    if spec is None:
+        raise KeyError(f"unknown driver {name!r}; known: {driver_names()}")
+    fn, args, meta = spec.build(grid, n, nb, dtype)
+    with redist_counts():                      # isolate the global counter
+        with redist_trace() as log:
+            closed = jax.make_jaxpr(fn)(*args)
+    events = collect_events(closed)
+    full_meta = {"n": n, "nb": nb, "dtype": jnp.dtype(dtype).name,
+                 "input_dtypes": [jnp.dtype(a.dtype).name for a in args],
+                 "allow_bf16": spec.allow_bf16}
+    full_meta.update(meta)
+    plan = plan_from_parts(name, (grid.height, grid.width), full_meta,
+                           events, log)
+    return plan, closed, log
+
+
+def trace_callable(fn, args, name: str = "custom", grid=None, meta=None):
+    """Trace an arbitrary driver callable (used by tests and the linter's
+    seeded-regression harness).  ``args`` are ShapeDtypeStructs (or
+    arrays); returns ``(CommPlan, closed_jaxpr, redist_log)``."""
+    with redist_counts():
+        with redist_trace() as log:
+            closed = jax.make_jaxpr(fn)(*args)
+    events = collect_events(closed)
+    gshape = (grid.height, grid.width) if grid is not None else (0, 0)
+    full_meta = {"input_dtypes": [jnp.dtype(a.dtype).name for a in args]}
+    full_meta.update(meta or {})
+    plan = plan_from_parts(name, gshape, full_meta, events, log)
+    return plan, closed, log

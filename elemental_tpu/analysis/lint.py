@@ -1,0 +1,169 @@
+"""Rule-based lints over a comm plan + redistribution trace.
+
+Each rule inspects the statically extracted comm schedule (the jaxpr-level
+:class:`~elemental_tpu.analysis.jaxpr_walk.CollectiveEvent` list and/or
+the engine's :class:`~elemental_tpu.redist.engine.RedistRecord` log) and
+reports :class:`LintFinding` objects.  Rules:
+
+  EL001 fuse-adjacent-gathers   two back-to-back redistributions of the
+        SAME [VC,STAR]/[STAR,VC] panel onto the [MC,STAR]+[STAR,MR]
+        operand pair -- the exact shape :func:`panel_spread` fuses into
+        one collective round (cholesky/herk's trailing chain pre-PR2).
+  EL002 redundant-round-trip    a redistribution whose output is fed
+        UNTOUCHED (same object -- provably no intervening compute) into a
+        redistribution straight back to the source distribution: the pair
+        is a no-op costing two collective rounds.
+  EL003 loop-invariant-collective   a collective inside a scan/while body
+        whose operands derive only from loop constants -- hoistable.
+  EL004 f64-promotion           a collective moving float64/complex128
+        bytes in a program traced from <=32-bit inputs: an unintended
+        promotion doubling wire bytes (x64 mode makes these easy to leak).
+  EL005 bf16-leak               a collective moving bfloat16 outside the
+        opt-in ``update_precision`` paths (``allow_bf16`` in the driver
+        spec): bf16 on the wire silently halves mantissa everywhere.
+
+``lint_plan`` returns findings sorted by rule id; an empty list means the
+plan is clean (the ``perf/comm_audit.py lint`` CLI exits non-zero on any
+finding).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from .jaxpr_walk import find_loop_invariant_collectives
+
+_NARROW = ("float16", "bfloat16", "float32", "complex64", "int32", "int16",
+           "int8", "uint32", "uint16", "uint8", "bool")
+_WIDE = ("float64", "complex128")
+
+
+@dataclasses.dataclass(frozen=True)
+class LintFinding:
+    rule: str          # "EL00x"
+    name: str          # short rule slug
+    message: str       # human-readable, names the offending site
+    severity: str = "warning"
+
+    def __str__(self):
+        return f"{self.rule} [{self.name}] {self.message}"
+
+
+# ---------------------------------------------------------------------
+# individual rules
+# ---------------------------------------------------------------------
+
+def _is_v_panel(dist) -> bool:
+    names = tuple(d.value for d in dist)
+    return names in (("VC", "STAR"), ("STAR", "VC"),
+                     ("VR", "STAR"), ("STAR", "VR"))
+
+
+def _spread_target(dist) -> bool:
+    names = tuple(d.value for d in dist)
+    return names in (("MC", "STAR"), ("STAR", "MR"),
+                     ("MR", "STAR"), ("STAR", "MC"))
+
+
+def rule_fuse_adjacent_gathers(plan, redist_log) -> list:
+    """EL001: the panel + its adjoint spread issued as separate calls."""
+    out = []
+    recs = [r for r in redist_log if r.kind == "redistribute"]
+    for a, b in zip(recs, recs[1:]):
+        if not (_is_v_panel(a.src) and _spread_target(a.dst)):
+            continue
+        if not (_is_v_panel(b.src) and _spread_target(b.dst)):
+            continue
+        if a.dst == b.dst:
+            continue
+        # same panel extents (the adjoint chain transposes the gshape)
+        if a.gshape not in (b.gshape, b.gshape[::-1]):
+            continue
+        out.append(LintFinding(
+            "EL001", "fuse-adjacent-gathers",
+            f"adjacent panel spreads {a.label} then {b.label} on a "
+            f"{a.gshape} panel: fuse into one panel_spread() round "
+            f"(one all_gather instead of separate gather chains)"))
+    return out
+
+
+def rule_redundant_round_trip(plan, redist_log) -> list:
+    """EL002: A->X then X->A on the untouched intermediate."""
+    out = []
+    recs = [r for r in redist_log if r.kind == "redistribute"]
+    by_out = {}
+    for r in recs:
+        for oid in r.out_ids:
+            by_out[oid] = r
+    for r in recs:
+        prev = by_out.get(r.in_id)
+        if prev is None or prev is r:
+            continue
+        if prev.src == r.dst and prev.dst == r.src \
+                and prev.gshape == r.gshape:
+            out.append(LintFinding(
+                "EL002", "redundant-round-trip",
+                f"{prev.label} then {r.label} on the SAME untouched "
+                f"{r.gshape} operand: the round trip is a no-op costing "
+                f"two redistribution rounds"))
+    return out
+
+
+def rule_loop_invariant(plan, closed_jaxpr=None) -> list:
+    """EL003: hoistable collectives inside scan/while bodies."""
+    if closed_jaxpr is None:
+        return []
+    out = []
+    for prim, path in find_loop_invariant_collectives(closed_jaxpr):
+        where = "/".join(path) or "<top>"
+        out.append(LintFinding(
+            "EL003", "loop-invariant-collective",
+            f"{prim} inside {where} has loop-invariant operands: "
+            f"hoist it out of the loop body"))
+    return out
+
+
+def rule_f64_promotion(plan) -> list:
+    """EL004: wide dtypes on the wire from narrow inputs."""
+    in_dtypes = plan.meta.get("input_dtypes") or [plan.meta.get("dtype")]
+    if any(str(d) in _WIDE for d in in_dtypes if d):
+        return []          # wide inputs: wide collectives are intended
+    out = []
+    seen = set()
+    for ev in plan.events:
+        if ev.dtype in _WIDE and (ev.prim, ev.dtype, ev.shape) not in seen:
+            seen.add((ev.prim, ev.dtype, ev.shape))
+            out.append(LintFinding(
+                "EL004", "f64-promotion",
+                f"{ev.prim} moves {ev.dtype} {ev.shape} at "
+                f"{'/'.join(ev.path)} but the traced inputs are "
+                f"{[str(d) for d in in_dtypes]}: unintended promotion "
+                f"doubles wire bytes"))
+    return out
+
+
+def rule_bf16_leak(plan) -> list:
+    """EL005: bf16 collectives without the update_precision opt-in."""
+    if plan.meta.get("allow_bf16"):
+        return []
+    out = []
+    seen = set()
+    for ev in plan.events:
+        if ev.dtype == "bfloat16" and (ev.prim, ev.shape) not in seen:
+            seen.add((ev.prim, ev.shape))
+            out.append(LintFinding(
+                "EL005", "bf16-leak",
+                f"{ev.prim} moves bfloat16 {ev.shape} at "
+                f"{'/'.join(ev.path)} without the update_precision "
+                f"opt-in: bf16 on the wire halves mantissa silently"))
+    return out
+
+
+def lint_plan(plan, redist_log=(), closed_jaxpr=None) -> list:
+    """Run every rule; findings sorted by rule id (empty == clean)."""
+    findings = []
+    findings += rule_fuse_adjacent_gathers(plan, redist_log)
+    findings += rule_redundant_round_trip(plan, redist_log)
+    findings += rule_loop_invariant(plan, closed_jaxpr)
+    findings += rule_f64_promotion(plan)
+    findings += rule_bf16_leak(plan)
+    return sorted(findings, key=lambda f: (f.rule, f.message))

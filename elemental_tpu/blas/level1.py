@@ -13,12 +13,9 @@ ops (trapezoidal masks, diagonals) need the cyclic index maps.
 """
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
-from ..core import indexing as ix
-from ..core.dist import Dist, MC, MR, STAR, MD
-from ..core.distmatrix import DistMatrix, from_global
+from ..core.distmatrix import DistMatrix
 from ..redist.engine import redistribute, transpose_dist
 
 

@@ -32,7 +32,7 @@ from jax import lax
 from ..core.dist import MC, MR, VC, VR, STAR
 from ..core.distmatrix import DistMatrix, zeros as dm_zeros
 from ..core.view import view, update_view, round_up
-from ..redist.engine import to_dist, redistribute, transpose_dist, panel_spread
+from ..redist.engine import redistribute, transpose_dist, panel_spread
 from .level1 import _global_indices
 
 

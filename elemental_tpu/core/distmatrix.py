@@ -34,7 +34,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from . import indexing as ix
-from .dist import (Dist, STAR, LEGAL_PAIRS, stride as dist_stride,
+from .dist import (Dist, LEGAL_PAIRS, stride as dist_stride,
                    storage_slots, spec_component, rank_of, md_slot_of_global)
 from .grid import Grid, default_grid
 

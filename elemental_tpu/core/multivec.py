@@ -135,10 +135,11 @@ def _validate_update_indices(rows, cols, m: int, n: int, gshape) -> None:
     traced indices, where the caller guarantees bounds; writes into the
     zero-padding tail would corrupt padding-oblivious reductions)."""
     import numpy as _np
+    from jax.errors import TracerArrayConversionError
     try:
         ri = _np.asarray(rows)
         ci = _np.asarray(cols)
-    except Exception:
+    except TracerArrayConversionError:
         return                      # traced: caller guarantees bounds
     if ri.size and (ri.min() < 0 or ri.max() >= m
                     or ci.min() < 0 or ci.max() >= n):

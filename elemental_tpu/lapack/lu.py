@@ -279,9 +279,18 @@ def _local_lu(A: DistMatrix, nb: int | None, precision,
     dispatch of the reference).  ``lookahead=True`` runs the pipelined
     schedule from the module docstring; ``False`` keeps the classic
     right-looking order (the A/B baseline)."""
-    a = A.local
-    m, n = A.gshape
-    ib = max(nb or 1024, 1)
+    a, perm = _local_lu_array(A.local, A.gshape[0], A.gshape[1],
+                              max(nb or 1024, 1), precision,
+                              update_precision, lookahead, timer)
+    return A.with_local(a), perm
+
+
+def _local_lu_array(a, m: int, n: int, ib: int, precision,
+                    update_precision=None, lookahead: bool = True,
+                    timer=None):
+    """Blocked LU of a plain (replicated) array: the sequential engine
+    behind both the 1x1-grid path and the distributed loop's
+    crossover-to-local tail.  Returns ``(packed LU array, perm)``."""
     kend = min(m, n)
     perm = jnp.arange(m)
     upd = precision if update_precision is None else update_precision
@@ -339,11 +348,21 @@ def _local_lu(A: DistMatrix, nb: int | None, precision,
         # swap + panel writeback fully overwrite it, so skipping its
         # writeback saves one (m-e) x nb store per step
         tm.tick("update", k, a)
-    return A.with_local(a), perm
+    return a, perm
+
+
+#: default crossover-to-local threshold for the look-ahead schedule (the
+#: Cholesky PR-2 trade, same default): once the trailing block is at most
+#: this size, ONE [STAR,STAR] gather + a replicated local finish replaces
+#: the remaining per-step collective latency.  A trailing t x t block
+#: costs ~t/nb more panel gathers + solve rounds distributed, vs one
+#: gather of t^2 words here -- latency-bound for small t on real meshes.
+_CROSSOVER = 4096
 
 
 def lu(A: DistMatrix, nb: int | None = None, precision=None,
-       update_precision=None, lookahead: bool = True, timer=None):
+       update_precision=None, lookahead: bool = True,
+       crossover: int | None = None, timer=None):
     """Blocked right-looking LU with partial pivoting and look-ahead.
 
     Returns (LU, perm): LU holds unit-lower L below the diagonal and U on
@@ -351,8 +370,14 @@ def lu(A: DistMatrix, nb: int | None = None, precision=None,
     with perm[i] = original index of the row now at position i, so
     ``P A = L U`` with ``(P A)[i] = A[perm[i]]``.
 
-    ``lookahead`` selects the pipelined schedule (module docstring);
-    ``update_precision`` optionally lowers ONLY the trailing ``L21 @ U12``
+    ``crossover`` is the trailing-block size at which the distributed loop
+    gathers the remaining (rows x cols <= crossover^2) block once,
+    finishes it with the replicated sequential kernel, and applies the
+    tail's row permutation in one storage-level pass (``None`` =
+    :data:`_CROSSOVER` with look-ahead, disabled classic; 0 never crosses
+    over).  ``lookahead`` selects the pipelined schedule (module
+    docstring); ``update_precision`` optionally lowers ONLY the trailing
+    ``L21 @ U12``
     updates (e.g. ``lax.Precision.DEFAULT`` for bf16-MXU throughput at a
     documented ~1e-3 residual cost); ``timer`` enables eager per-phase
     wall-clock attribution (see ``perf/phase_timer.py``)."""
@@ -366,6 +391,8 @@ def lu(A: DistMatrix, nb: int | None = None, precision=None,
     kend = min(m, n)
     perm = jnp.arange(m)
     upd = precision if update_precision is None else update_precision
+    xover = (_CROSSOVER if lookahead else 0) if crossover is None \
+        else max(int(crossover), 0)
     tm = timer if timer is not None else _NULL_TIMER
     tm.start()
 
@@ -386,6 +413,11 @@ def lu(A: DistMatrix, nb: int | None = None, precision=None,
         e = min(s + ib, kend)
         nbw = e - s
         e_up = col_up(e)
+        # crossover-to-local: after this step's update the remaining
+        # (m-e) x (n-e) trailing block is small enough that ONE gather +
+        # a replicated sequential finish beats the per-step collective
+        # latency of the remaining steps (e is stride-aligned: e < kend)
+        tail = bool(xover) and e < kend and m - e <= xover and n - e <= xover
         if lookahead:
             Pf, pperm = nxt
         else:
@@ -433,6 +465,10 @@ def lu(A: DistMatrix, nb: int | None = None, precision=None,
                                       rows=(e, m), cols=(e, n),
                                       precision=upd)
                 tm.tick("update", k, A)
+            if tail:
+                A, perm = _lu_tail(A, perm, e, ib, precision, upd,
+                                   lookahead, tm, k)
+                break
             continue
         # look-ahead: split the trailing update at the next panel boundary.
         # All operands are captured from the PRE-writeback A, so the panel
@@ -447,11 +483,13 @@ def lu(A: DistMatrix, nb: int | None = None, precision=None,
         stripD = A22a.with_local(
             A22a.local - jnp.matmul(L21_mc.local, U12a.local,
                                     precision=upd).astype(A.dtype))
-        # factor panel k+1 from the freshly updated strip (gshape already
-        # (m-e, e2_up-e) from the view metadata)
-        strip_ss = redistribute(stripD, STAR, STAR)
-        nxt = _panel_lu(strip_ss.local[:, :e2 - e], e2 - e, precision)
-        tm.tick("panel", k + 1, nxt)
+        if not tail:
+            # factor panel k+1 from the freshly updated strip (gshape
+            # already (m-e, e2_up-e) from the view metadata); skipped when
+            # the tail finish below refactors the whole trailing block
+            strip_ss = redistribute(stripD, STAR, STAR)
+            nxt = _panel_lu(strip_ss.local[:, :e2 - e], e2 - e, precision)
+            tm.tick("panel", k + 1, nxt)
         # (b) wide remainder update, cols >= e2_up
         if e2_up < n:
             U12b = view(U1n_mr, cols=(e2_up - s, n - s))
@@ -468,6 +506,37 @@ def lu(A: DistMatrix, nb: int | None = None, precision=None,
         if restD is not None:
             A = update_view(A, restD, rows=(e, m), cols=(e2_up, n))
         tm.tick("update", k, A)
+        if tail:
+            A, perm = _lu_tail(A, perm, e, ib, precision, upd, lookahead,
+                               tm, k)
+            break
+    return A, perm
+
+
+def _lu_tail(A: DistMatrix, perm, e: int, ib: int, precision, upd,
+             lookahead: bool, tm, k: int):
+    """Crossover-to-local finish of the (fully updated) trailing block.
+
+    One [STAR,STAR] gather of rows/cols >= e, a replicated run of the
+    sequential blocked kernel (identical deterministic results on every
+    device, like the panel factorization), one storage-level row
+    permutation of the already-factored left columns, and a pure-local
+    scatter of the factored tail -- the remaining t/nb steps of per-step
+    collective latency collapse into a single round trip."""
+    m, n = A.gshape
+    g = A.grid
+    Atail = redistribute(view(A, rows=(e, m), cols=(e, n)), STAR, STAR)
+    at, pt = _local_lu_array(Atail.local, m - e, n - e, ib, precision,
+                             upd, lookahead)
+    # the tail's composed row permutation applies to the WHOLE row range
+    # (the left factored columns must see the same swaps); cols >= e are
+    # overwritten by the factored-tail writeback right after
+    A = _apply_swaps_moved(A, jnp.arange(m - e) + e, pt + e,
+                           jnp.ones(m - e, dtype=bool))
+    At_ss = DistMatrix(at, (m - e, n - e), STAR, STAR, 0, 0, g)
+    A = update_view(A, redistribute(At_ss, MC, MR), rows=(e, m), cols=(e, n))
+    perm = perm.at[e:].set(jnp.take(perm[e:], pt, axis=0))
+    tm.tick("tail", k, A)
     return A, perm
 
 

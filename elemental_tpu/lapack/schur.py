@@ -38,11 +38,9 @@ from __future__ import annotations
 import math
 
 import numpy as np
-import jax
 import jax.numpy as jnp
-from jax import lax
 
-from ..core.dist import MC, MR, STAR, VR
+from ..core.dist import MC, MR, STAR
 from ..core.distmatrix import DistMatrix, from_global, to_global
 from ..redist.engine import redistribute, transpose_dist
 from ..redist.interior import interior_view, interior_update, _blank
@@ -52,7 +50,6 @@ from ..blas.level3 import _check_mcmr, _blocksize, gemm
 from .lu import _hi
 from .funcs import sign as _matrix_sign
 from .qr import qr, apply_q
-from ..core.view import view, update_view
 
 
 def _complex_dtype(dtype):

@@ -11,7 +11,7 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
-from ..core.dist import Dist, MC, MR
+from ..core.dist import MC, MR
 from ..core.distmatrix import DistMatrix, from_global, zeros as dm_zeros
 from ..core.grid import Grid, default_grid
 from ..blas.level1 import index_dependent_fill, shift_diagonal

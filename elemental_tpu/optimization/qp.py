@@ -21,7 +21,6 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.dist import MC, MR
 from ..core.distmatrix import DistMatrix
 from ..redist.interior import interior_view, interior_update, vstack, _blank
 from ..blas.level1 import _valid_mask, update_diagonal

@@ -33,13 +33,11 @@ host, matching the SURVEY.md §4.6 host/device split.
 """
 from __future__ import annotations
 
-import dataclasses
-
 import numpy as np
 import jax.numpy as jnp
 
 from ..core.multivec import (DistMultiVec, mv_axpy, mv_dot, mv_from_global,
-                             mv_nrm2, mv_scale, mv_to_global, mv_zeros)
+                             mv_nrm2, mv_to_global)
 from ..sparse.core import DistSparseMatrix, dist_sparse_from_coo
 from .util import MehrotraCtrl
 

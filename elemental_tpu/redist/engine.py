@@ -26,6 +26,7 @@ fall back otherwise.
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from collections import Counter
 from functools import partial
@@ -51,6 +52,90 @@ from ..core.distmatrix import DistMatrix, _check_pair
 #: measurements with ``REDIST_COUNTS.clear()``.  Counts python-level entry
 #: calls, not executed collectives -- jit caching does not hide them.
 REDIST_COUNTS: Counter = Counter()
+
+
+@contextlib.contextmanager
+def redist_counts():
+    """Scoped redistribute/panel_spread call counting.
+
+    Swaps a fresh Counter in for the module-global :data:`REDIST_COUNTS`
+    for the duration of the block and yields it: counts observed inside
+    the block accumulate on the yielded Counter (readable both during and
+    after the block), and the previous global counter is restored
+    untouched on exit -- so counter state cannot leak between tests or
+    measurements.  The module-level ``REDIST_COUNTS`` name remains as the
+    backward-compatible process-global default for code that does not use
+    the context manager (note: ``from ... import REDIST_COUNTS`` binds the
+    *current* counter object; prefer this context manager, the
+    ``redist_counter`` pytest fixture, or attribute access via the
+    module)."""
+    global REDIST_COUNTS
+    prev = REDIST_COUNTS
+    cur: Counter = Counter()
+    REDIST_COUNTS = cur
+    try:
+        yield cur
+    finally:
+        REDIST_COUNTS = prev
+
+
+# ---------------------------------------------------------------------
+# dist-metadata trace hook (the static comm-plan analyzer's view of the
+# engine: elemental_tpu/analysis/ correlates these Python-level records
+# with the collectives it finds in the traced jaxpr)
+# ---------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class RedistRecord:
+    """One public-entry redistribution call observed under redist_trace."""
+    kind: str            # "redistribute" | "panel_spread"
+    src: tuple           # (cdist, rdist) Dist pair of the source
+    dst: tuple           # target pair ("panel_spread": the [MC,*]/[*,MR] pair)
+    gshape: tuple        # source global shape
+    dtype: str
+    in_id: int           # id() of the source local array/tracer
+    out_ids: tuple       # id() of the produced local array(s)/tracer(s)
+    # live references keep the ids above unambiguous (no id reuse after GC)
+    refs: tuple = dataclasses.field(default=(), repr=False, compare=False)
+
+    @property
+    def label(self) -> str:
+        if self.kind == "panel_spread":
+            return "panel_spread"
+        s = f"[{self.src[0].value},{self.src[1].value}]"
+        d = f"[{self.dst[0].value},{self.dst[1].value}]"
+        return f"{s}->{d}"
+
+
+_REDIST_TRACE: list | None = None
+
+
+@contextlib.contextmanager
+def redist_trace():
+    """Record dist-level metadata for every :func:`redistribute` /
+    :func:`panel_spread` entry inside the block.
+
+    Yields the live list of :class:`RedistRecord`; the analyzer uses the
+    ``in_id``/``out_ids`` object identities to prove data-flow adjacency
+    (a record whose input IS a previous record's untouched output had no
+    intervening compute -- the round-trip lint)."""
+    global _REDIST_TRACE
+    prev = _REDIST_TRACE
+    log: list = []
+    _REDIST_TRACE = log
+    try:
+        yield log
+    finally:
+        _REDIST_TRACE = prev
+
+
+def _trace_record(kind, src, dst, gshape, dtype, objs_in, objs_out):
+    if _REDIST_TRACE is not None:
+        _REDIST_TRACE.append(RedistRecord(
+            kind=kind, src=tuple(src), dst=tuple(dst), gshape=tuple(gshape),
+            dtype=str(dtype), in_id=id(objs_in),
+            out_ids=tuple(id(o) for o in objs_out),
+            refs=(objs_in,) + tuple(objs_out)))
 
 
 # ---------------------------------------------------------------------
@@ -599,7 +684,10 @@ def panel_spread(A: DistMatrix, conj: bool = True):
         raise ValueError(f"panel_spread needs a zero-aligned [VC,STAR] "
                          f"panel, got {A}")
     REDIST_COUNTS["panel_spread"] += 1
-    return _panel_spread_jit(A, conj)
+    mc, mr = _panel_spread_jit(A, conj)
+    _trace_record("panel_spread", A.dist, ((MC, STAR), (STAR, MR)),
+                  A.gshape, A.dtype, A.local, (mc.local, mr.local))
+    return mc, mr
 
 
 # ---------------------------------------------------------------------
@@ -692,21 +780,30 @@ def redistribute(A: DistMatrix, cdist: Dist, rdist: Dist,
     _check_pair(cdist, rdist)
     REDIST_COUNTS[(A.dist, (cdist, rdist))] += 1
     if cdist is CIRC or A.cdist is CIRC:
-        from ..core.distmatrix import from_global, to_global
-        import jax.sharding as jsh
-        g = A.grid
-        if A.cdist is CIRC and cdist is CIRC:
-            return A
-        if cdist is CIRC:
-            arr = to_global(A)               # device computation on storage
-            arr = jax.device_put(
-                arr, jsh.SingleDeviceSharding(g.mesh.devices.flat[0]))
-            return DistMatrix(arr, A.gshape, CIRC, CIRC, 0, 0, g)
-        # CIRC source: broadcast the root array, then scatter normally
-        arr = jax.device_put(A.local, g.sharding(jax.sharding.PartitionSpec()))
-        return from_global(arr, cdist, rdist, grid=g,
-                           calign=calign, ralign=ralign)
-    return _redistribute_jit(A, cdist, rdist, calign, ralign)
+        out = _redistribute_circ(A, cdist, rdist, calign, ralign)
+    else:
+        out = _redistribute_jit(A, cdist, rdist, calign, ralign)
+    _trace_record("redistribute", A.dist, (cdist, rdist), A.gshape,
+                  A.dtype, A.local, (out.local,))
+    return out
+
+
+def _redistribute_circ(A: DistMatrix, cdist: Dist, rdist: Dist,
+                       calign: int, ralign: int) -> DistMatrix:
+    from ..core.distmatrix import from_global, to_global
+    import jax.sharding as jsh
+    g = A.grid
+    if A.cdist is CIRC and cdist is CIRC:
+        return A
+    if cdist is CIRC:
+        arr = to_global(A)               # device computation on storage
+        arr = jax.device_put(
+            arr, jsh.SingleDeviceSharding(g.mesh.devices.flat[0]))
+        return DistMatrix(arr, A.gshape, CIRC, CIRC, 0, 0, g)
+    # CIRC source: broadcast the root array, then scatter normally
+    arr = jax.device_put(A.local, g.sharding(jax.sharding.PartitionSpec()))
+    return from_global(arr, cdist, rdist, grid=g,
+                       calign=calign, ralign=ralign)
 
 
 @partial(jax.jit, static_argnums=(1, 2, 3, 4))

@@ -12,7 +12,6 @@ split (SURVEY.md §4.6).
 from __future__ import annotations
 
 import numpy as np
-import jax
 import jax.numpy as jnp
 
 from ..core.multivec import (DistMultiVec, mv_axpy, mv_dot, mv_nrm2,

@@ -10,6 +10,9 @@ before/after pair).  Usage:
                                             #   nb + _INNERS sweep (dflt 16384)
     python perf/ab_harness.py cholesky [N]  # Cholesky: classic vs look-ahead
                                             #   x nb x crossover (dflt 16384)
+    python perf/ab_harness.py lu-dist [N]   # distributed LU: classic vs
+                                            #   look-ahead x tail crossover
+                                            #   on ALL visible devices
     python perf/ab_harness.py phases [lu|cholesky] [N NB]
                                             # per-step phase wall-clock as
                                             #   one phase_timings/v1 JSON line
@@ -156,30 +159,36 @@ def run_lu(n=None):
                                             jnp.float32))
     nb0 = 2048 if on_tpu else 128
 
-    # (name, lookahead, inners, nb, update_precision)
+    # (name, lookahead, inners, nb, update_precision, crossover)
+    # xover=0 everywhere: this is the SINGLE-CHIP schedule harness (the
+    # sequential path has no redistribution tail); the distributed LU
+    # crossover A/B is `ab_harness.py lu-dist`, mirroring run_cholesky.
     cases = [
-        (f"classic        inners=(512,64) nb={nb0}", False, (512, 64), nb0, None),
-        (f"look-ahead     inners=(512,64) nb={nb0}", True, (512, 64), nb0, None),
+        (f"classic        inners=(512,64) nb={nb0}", False, (512, 64), nb0,
+         None, 0),
+        (f"look-ahead     inners=(512,64) nb={nb0}", True, (512, 64), nb0,
+         None, 0),
         (f"look-ahead     inners=(512,64) nb={nb0 // 2}", True, (512, 64),
-         nb0 // 2, None),
+         nb0 // 2, None, 0),
         (f"look-ahead     inners=(512,64) nb={nb0 * 2}", True, (512, 64),
-         nb0 * 2, None),
-        (f"look-ahead     inners=(768,96) nb={nb0}", True, (768, 96), nb0, None),
+         nb0 * 2, None, 0),
+        (f"look-ahead     inners=(768,96) nb={nb0}", True, (768, 96), nb0,
+         None, 0),
         (f"look-ahead     inners=(1024,128) nb={nb0}", True, (1024, 128),
-         nb0, None),
+         nb0, None, 0),
         (f"look-ahead     inners=(512,128,32) nb={nb0}", True, (512, 128, 32),
-         nb0, None),
+         nb0, None, 0),
         (f"look-ahead+bf16upd inners=(512,64) nb={nb0}", True, (512, 64),
-         nb0, DEF),
+         nb0, DEF, 0),
     ]
 
     orig_inners = lu_mod._INNERS
-    for name, la, inners, nb, upd in cases:
+    for name, la, inners, nb, upd, xover in cases:
         lu_mod._INNERS = inners
         lufn = jax.jit(
-            lambda a, _nb=nb, _la=la, _u=upd: tuple(
+            lambda a, _nb=nb, _la=la, _u=upd, _x=xover: tuple(
                 el.lu(a, nb=_nb, precision=HI, update_precision=_u,
-                      lookahead=_la)),
+                      lookahead=_la, crossover=_x)),
             donate_argnums=0)
 
         def step(A):
@@ -206,6 +215,47 @@ def run_lu(n=None):
         report(name, (2 * n ** 3 / 3) / dt / 1e12, 0.5 * (r0 + r1), extra)
         del lufn
     lu_mod._INNERS = orig_inners
+
+
+def run_lu_dist(n=None):
+    """ISSUE 3 A/B: distributed LU classic vs look-ahead x tail-crossover,
+    same process and grid (all visible devices), roofline-bracketed --
+    the LU twin of :func:`run_cholesky`.  On a single device the
+    crossover rows are skipped (the sequential path has no redistribution
+    tail to cross over from)."""
+    on_tpu = jax.devices()[0].platform != "cpu"
+    n = int(n) if n else (16384 if on_tpu else 512)
+    grid = el.Grid(jax.devices())
+    p = grid.size
+    nb0 = 2048 if on_tpu else 128
+
+    gen = jax.jit(lambda: jax.random.normal(jax.random.PRNGKey(1), (n, n),
+                                            jnp.float32))
+
+    def wrap(a):
+        return el.DistMatrix(a, (n, n), el.MC, el.MR, 0, 0, grid)
+
+    # (name, lookahead, nb, crossover)
+    cases = [
+        (f"classic        nb={nb0} xover=0", False, nb0, 0),
+        (f"look-ahead     nb={nb0} xover=0", True, nb0, 0),
+    ]
+    if p > 1:
+        for xo in (n // 8, n // 4, n // 2):
+            cases.append((f"look-ahead     nb={nb0} xover={xo}", True, nb0, xo))
+        cases.append((f"classic        nb={nb0} xover={n // 4}",
+                      False, nb0, n // 4))
+    print(f"grid {grid.height}x{grid.width}, n={n}", flush=True)
+    for name, la, nb, xo in cases:
+        step = jax.jit(
+            lambda a, _nb=nb, _la=la, _xo=xo: tuple(el.lu(
+                a, nb=_nb, precision=HI, lookahead=_la, crossover=_xo))[0].local,
+            donate_argnums=0)
+        r0 = roofline()
+        dt = timed(lambda: wrap(gen()), step)
+        r1 = roofline()
+        report(name, (2 * n ** 3 / 3) / dt / 1e12, 0.5 * (r0 + r1))
+        del step
 
 
 def run_cholesky(n=None):
@@ -303,6 +353,8 @@ if __name__ == "__main__":
         run_chol()
     elif mode == "lu":
         run_lu(*sys.argv[2:3])
+    elif mode == "lu-dist":
+        run_lu_dist(*sys.argv[2:3])
     elif mode == "cholesky":
         run_cholesky(*sys.argv[2:3])
     else:

@@ -1,0 +1,113 @@
+"""Golden comm-plan gates (ISSUE 3): every registered driver's collective
+schedule is pinned at the jaxpr level on 1x1 and 2x2 grids.
+
+These are TRACE-ONLY tests (no device execution), so the full registry
+sweep rides in tier 1: a PR that silently reintroduces a redistribution
+round, changes a collective's operand shape, or promotes a dtype fails
+here instead of in a benchmark.  Regenerate after an INTENTIONAL schedule
+change with ``python -m perf.comm_audit diff --update-golden`` and review
+the JSON diff.
+"""
+import json
+
+import jax
+import pytest
+
+from elemental_tpu import Grid
+from elemental_tpu import analysis as an
+from perf.comm_audit import GRIDS, golden_path
+
+
+def _grid(r, c):
+    return Grid(jax.devices()[: r * c], height=r)
+
+
+_CASES = [(d, g) for d in an.driver_names() for g in GRIDS]
+
+
+@pytest.mark.parametrize("driver,grid", _CASES,
+                         ids=[f"{d}-{r}x{c}" for d, (r, c) in _CASES])
+def test_plan_matches_golden(driver, grid):
+    plan, _, _ = an.trace_driver(driver, _grid(*grid))
+    path = golden_path(driver, grid)
+    with open(path) as f:
+        golden = json.load(f)
+    lines = an.diff_docs(golden, an.golden_doc(plan))
+    assert not lines, "comm plan drifted from golden " \
+        f"({path}):\n" + "\n".join(lines) + \
+        "\nIf intentional: python -m perf.comm_audit diff --update-golden"
+
+
+@pytest.mark.parametrize("la,classic", an.LOOKAHEAD_PAIRS)
+def test_lookahead_strictly_fewer_all_gathers(la, classic):
+    """The PR 1-2 fusions, pinned at the jaxpr level: the look-ahead
+    (crossover-tail) schedules issue strictly fewer all_gather rounds
+    than classic at equal n/nb on a real 2-D grid."""
+    g = _grid(2, 2)
+    plan_la, _, _ = an.trace_driver(la, g)
+    plan_cl, _, _ = an.trace_driver(classic, g)
+    assert plan_la.count("all_gather") < plan_cl.count("all_gather"), (
+        la, plan_la.totals(), classic, plan_cl.totals())
+    total_la = sum(t["count"] for t in plan_la.totals().values())
+    total_cl = sum(t["count"] for t in plan_cl.totals().values())
+    assert total_la < total_cl
+
+
+@pytest.mark.parametrize("name", ["cholesky", "lu"])
+def test_driver_default_config_fewer_rounds_than_classic(name):
+    """The DRIVER DEFAULTS (lookahead=True, crossover=None -> 4096) beat
+    classic at small n too -- the tail collapse is on by default."""
+    import jax.numpy as jnp
+    from elemental_tpu.core.dist import Dist
+    from elemental_tpu.core.distmatrix import DistMatrix
+    g = _grid(2, 2)
+    n, nb = 64, 16
+    shape = an.storage_shape(n, n, Dist.MC, Dist.MR, g)
+
+    def make(lookahead):
+        def fn(a):
+            A = DistMatrix(a, (n, n), Dist.MC, Dist.MR, 0, 0, g)
+            if name == "cholesky":
+                from elemental_tpu.lapack.cholesky import cholesky
+                return cholesky(A, nb=nb, lookahead=lookahead)
+            from elemental_tpu.lapack.lu import lu
+            return lu(A, nb=nb, lookahead=lookahead)
+        return fn
+
+    arg = jax.ShapeDtypeStruct(shape, jnp.float32)
+    plan_la, _, _ = an.trace_callable(make(True), (arg,), grid=g)
+    plan_cl, _, _ = an.trace_callable(make(False), (arg,), grid=g)
+    assert plan_la.count("all_gather") < plan_cl.count("all_gather")
+
+
+@pytest.mark.parametrize("driver", ["cholesky_classic", "cholesky_crossover",
+                                    "lu_classic", "lu_crossover", "herk"])
+def test_analyzer_agrees_with_redist_counts(driver):
+    """Cross-check the jaxpr view against the Python-call counters: each
+    public redistribute()/panel_spread() call must appear as exactly one
+    correspondingly named pjit equation in the traced program."""
+    plan, closed, log = an.trace_driver(driver, _grid(2, 2))
+    n_redist = sum(1 for r in log if r.kind == "redistribute")
+    n_spread = sum(1 for r in log if r.kind == "panel_spread")
+    assert an.count_pjit_calls(closed, "_redistribute_jit") == n_redist
+    assert an.count_pjit_calls(closed, "_panel_spread_jit") == n_spread
+    # and the plan's aggregated labels reproduce the counter totals
+    assert sum(plan.redistributes.values()) == n_redist + n_spread
+
+
+def test_plans_are_static_and_clean():
+    """No driver hides collectives behind unbounded while loops, and the
+    full registry is lint-clean on both grids."""
+    for driver, grid in _CASES:
+        plan, closed, log = an.trace_driver(driver, _grid(*grid))
+        assert plan.static, driver
+        findings = an.lint_plan(plan, log, closed)
+        assert findings == [], (driver, grid, [str(f) for f in findings])
+
+
+def test_size_one_axis_collectives_cost_zero():
+    """1x1-grid plans may contain degenerate (axis_size==1) collective
+    equations; the byte model prices them at zero."""
+    plan, _, _ = an.trace_driver("gemm_a", _grid(1, 1))
+    for ev in plan.events:
+        assert ev.axis_size == 1 and ev.bytes_per_call == 0

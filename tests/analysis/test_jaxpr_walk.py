@@ -1,0 +1,120 @@
+"""Unit tests for the recursive jaxpr collective walker (ISSUE 3)."""
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from elemental_tpu import Grid
+from elemental_tpu.analysis import (collect_events, count_pjit_calls,
+                                    estimate_bytes,
+                                    find_loop_invariant_collectives)
+from elemental_tpu.core.compat import shard_map
+
+
+@pytest.fixture(scope="module")
+def g22():
+    return Grid(jax.devices()[:4], height=2)
+
+
+def _smap(g, fn, n_in=1):
+    def outer(*args):
+        return shard_map(fn, mesh=g.mesh, in_specs=(P(),) * n_in,
+                         out_specs=P(), check_vma=False)(*args)
+    return outer
+
+
+def test_psum_event_axes_and_bytes(g22):
+    fn = _smap(g22, lambda x: lax.psum(x, ("mc", "mr")))
+    closed = jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((8, 8), jnp.float32))
+    evs = collect_events(closed)
+    assert len(evs) == 1
+    ev = evs[0]
+    assert ev.prim == "psum" and set(ev.axes) == {"mc", "mr"}
+    assert ev.axis_size == 4 and ev.shape == (8, 8)
+    assert ev.dtype == "float32" and ev.count == 1 and ev.static
+    assert ev.bytes_per_call == estimate_bytes("psum", 8 * 8 * 4, 4)
+
+
+def test_scan_multiplies_count(g22):
+    def body(x):
+        def step(c, _):
+            return c + lax.psum(c, "mc"), None
+        out, _ = lax.scan(step, x, None, length=5)
+        return out
+
+    closed = jax.make_jaxpr(_smap(g22, body))(
+        jax.ShapeDtypeStruct((4,), jnp.float32))
+    evs = collect_events(closed)
+    assert len(evs) == 1
+    assert evs[0].count == 5 and evs[0].static
+    assert any(p.startswith("scan[5]") for p in evs[0].path)
+
+
+def test_while_marks_non_static(g22):
+    def body(x):
+        def cond(c):
+            return c[0] < 3
+
+        def step(c):
+            return (c[0] + 1, c[1] + lax.psum(c[1], "mr"))
+        return lax.while_loop(cond, step, (0, x))[1]
+
+    closed = jax.make_jaxpr(_smap(g22, body))(
+        jax.ShapeDtypeStruct((4,), jnp.float32))
+    evs = collect_events(closed)
+    assert len(evs) == 1 and not evs[0].static
+
+
+def test_nested_pjit_recursion_and_count(g22):
+    @jax.jit
+    def inner(x):
+        return lax.psum(x, "mc")
+
+    def body(x):
+        return inner(x) + inner(x)
+
+    closed = jax.make_jaxpr(_smap(g22, body))(
+        jax.ShapeDtypeStruct((4,), jnp.float32))
+    evs = collect_events(closed)
+    assert [e.prim for e in evs] == ["psum", "psum"]
+    assert all("pjit:inner" in e.path for e in evs)
+    assert count_pjit_calls(closed, "inner") == 2
+
+
+def test_estimate_bytes_formulas():
+    nb = 1000
+    assert estimate_bytes("all_gather", nb, 4) == 3000
+    assert estimate_bytes("reduce_scatter", nb, 4) == 750
+    assert estimate_bytes("psum", nb, 4) == 1500
+    assert estimate_bytes("all_to_all", nb, 4) == 750
+    assert estimate_bytes("ppermute", nb, 4) == nb
+    assert estimate_bytes("all_gather", nb, 1) == 0
+
+
+def test_loop_invariant_collective_found(g22):
+    def body(x, y):
+        def step(c, _):
+            # psum of the loop-INVARIANT y: hoistable
+            return c + lax.psum(y, "mc"), None
+        out, _ = lax.scan(step, x, None, length=3)
+        return out
+
+    closed = jax.make_jaxpr(_smap(g22, body, n_in=2))(
+        jax.ShapeDtypeStruct((4,), jnp.float32),
+        jax.ShapeDtypeStruct((4,), jnp.float32))
+    found = find_loop_invariant_collectives(closed)
+    assert len(found) == 1 and found[0][0] == "psum"
+
+
+def test_loop_variant_collective_not_flagged(g22):
+    def body(x):
+        def step(c, _):
+            # psum of the CARRY: genuinely per-iteration
+            return c + lax.psum(c, "mc"), None
+        out, _ = lax.scan(step, x, None, length=3)
+        return out
+
+    closed = jax.make_jaxpr(_smap(g22, body))(
+        jax.ShapeDtypeStruct((4,), jnp.float32))
+    assert find_loop_invariant_collectives(closed) == []

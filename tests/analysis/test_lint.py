@@ -1,0 +1,127 @@
+"""Lint-rule tests (ISSUE 3): each rule fires on a seeded bad pattern and
+stays quiet once the pattern is removed -- the planted-regression gate."""
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from elemental_tpu import Grid
+from elemental_tpu import analysis as an
+from elemental_tpu.core.compat import shard_map
+from elemental_tpu.core.dist import Dist
+from elemental_tpu.core.distmatrix import DistMatrix
+from elemental_tpu.redist.engine import redistribute, transpose_dist
+
+MC, MR, VC, STAR = Dist.MC, Dist.MR, Dist.VC, Dist.STAR
+N = 16
+
+
+@pytest.fixture(scope="module")
+def g22():
+    return Grid(jax.devices()[:4], height=2)
+
+
+def _arg(g, n=N, dtype=jnp.float32):
+    return jax.ShapeDtypeStruct(an.storage_shape(n, n, MC, MR, g), dtype)
+
+
+def _toy(g, round_trip: bool):
+    """A toy driver that optionally plants the redundant
+    [MC,MR] -> [VC,STAR] -> [MC,MR] round trip of the ISSUE's seeded
+    regression: the intermediate is fed back UNTOUCHED, so the pair is
+    pure wasted communication."""
+    def fn(a):
+        A = DistMatrix(a, (N, N), MC, MR, 0, 0, g)
+        if round_trip:
+            A = redistribute(redistribute(A, VC, STAR), MC, MR)
+        ss = redistribute(A, STAR, STAR)
+        return ss.local @ ss.local
+    return fn
+
+
+def _lint(g, fn, meta=None):
+    plan, closed, log = an.trace_callable(fn, (_arg(g),), grid=g, meta=meta)
+    return an.lint_plan(plan, log, closed)
+
+
+def test_seeded_round_trip_reported(g22):
+    findings = _lint(g22, _toy(g22, round_trip=True))
+    assert any(f.rule == "EL002" for f in findings), \
+        [str(f) for f in findings]
+    # the finding names the planted pair
+    msg = next(str(f) for f in findings if f.rule == "EL002")
+    assert "[MC,MR]->[VC,STAR]" in msg and "[VC,STAR]->[MC,MR]" in msg
+
+
+def test_round_trip_removed_passes(g22):
+    assert _lint(g22, _toy(g22, round_trip=False)) == []
+
+
+def test_round_trip_with_intervening_compute_not_flagged(g22):
+    """Touching the intermediate (any compute) legitimizes the pattern:
+    the object-identity proof of adjacency must not fire."""
+    def fn(a):
+        A = DistMatrix(a, (N, N), MC, MR, 0, 0, g22)
+        V = redistribute(A, VC, STAR)
+        V = V.with_local(V.local * 2.0)          # compute on the panel
+        B = redistribute(V, MC, MR)
+        return B.local
+    assert [f.rule for f in _lint(g22, fn)] == []
+
+
+def test_adjacent_panel_spreads_flag_fusion(g22):
+    """The pre-PR2 cholesky/herk chain: the [VC,STAR] panel spread to
+    [MC,STAR] and its adjoint spread issued as separate redistributions
+    -- EL001 says fuse into panel_spread()."""
+    def fn(a):
+        A = DistMatrix(a, (N, N), MC, MR, 0, 0, g22)
+        V = redistribute(A, VC, STAR)
+        P_mc = redistribute(V, MC, STAR)
+        P_mr = redistribute(transpose_dist(V, conj=True), STAR, MR)
+        return P_mc.local, P_mr.local
+    findings = _lint(g22, fn)
+    assert any(f.rule == "EL001" and "panel_spread" in f.message
+               for f in findings), [str(f) for f in findings]
+
+
+def test_f64_promotion_flagged(g22):
+    def fn(a):
+        A = DistMatrix(a, (N, N), MC, MR, 0, 0, g22)
+        A64 = A.astype(jnp.float64)              # unintended promotion
+        return redistribute(A64, STAR, STAR).local
+    findings = _lint(g22, fn)
+    assert any(f.rule == "EL004" for f in findings)
+
+
+def test_bf16_leak_flagged_and_opt_in(g22):
+    def fn(a):
+        A = DistMatrix(a, (N, N), MC, MR, 0, 0, g22)
+        return redistribute(A.astype(jnp.bfloat16), STAR, STAR).local
+    findings = _lint(g22, fn)
+    assert any(f.rule == "EL005" for f in findings)
+    # the update_precision paths opt in via allow_bf16
+    assert _lint(g22, fn, meta={"allow_bf16": True}) == []
+
+
+def test_loop_invariant_collective_flagged(g22):
+    def fn(x, y):
+        def body(x, y):
+            def step(c, _):
+                return c + lax.psum(y, "mc"), None   # y is loop-invariant
+            return lax.scan(step, x, None, length=4)[0]
+        return shard_map(body, mesh=g22.mesh, in_specs=(P(), P()),
+                         out_specs=P(), check_vma=False)(x, y)
+    arg = jax.ShapeDtypeStruct((8,), jnp.float32)
+    plan, closed, log = an.trace_callable(fn, (arg, arg), grid=g22)
+    findings = an.lint_plan(plan, log, closed)
+    assert any(f.rule == "EL003" for f in findings)
+
+
+def test_comm_audit_lint_cli_exit_codes(g22, monkeypatch, capsys):
+    """End-to-end CLI contract: lint exits 0 on the clean registry and
+    the diff gate exits 0 against the committed goldens."""
+    from perf import comm_audit
+    assert comm_audit.main(["lint", "cholesky_crossover", "--grid", "2x2"]) == 0
+    assert comm_audit.main(["diff", "cholesky", "--grid", "2x2"]) == 0
+    capsys.readouterr()

@@ -9,6 +9,7 @@ import pytest
 
 from elemental_tpu import MC, MR, STAR, from_global, to_global
 from elemental_tpu.blas import level3 as l3
+from elemental_tpu.redist.engine import redist_counts as _redist_counts
 
 
 def _rng(seed=0):
@@ -84,7 +85,6 @@ def test_gemm_dot_p1_early_out():
     zero redistribute calls (pinned via the engine's call counts)."""
     import jax
     from elemental_tpu import Grid
-    from elemental_tpu.redist import engine
 
     g1 = Grid([jax.devices()[0]])
     rng = _rng(43)
@@ -92,9 +92,9 @@ def test_gemm_dot_p1_early_out():
     A, B = rng.normal(size=(m, k)), rng.normal(size=(k, n))
     C0 = rng.normal(size=(m, n))
     Ad, Bd, Cd = _dist(g1, A), _dist(g1, B), _dist(g1, C0)
-    engine.REDIST_COUNTS.clear()
-    out = l3.gemm(Ad, Bd, alpha=2.0, beta=-0.5, C=Cd, alg="dot")
-    assert not engine.REDIST_COUNTS, dict(engine.REDIST_COUNTS)
+    with _redist_counts() as counter:
+        out = l3.gemm(Ad, Bd, alpha=2.0, beta=-0.5, C=Cd, alg="dot")
+    assert not counter, dict(counter)
     np.testing.assert_allclose(np.asarray(to_global(out)),
                                2.0 * A @ B - 0.5 * C0, rtol=1e-12)
 
@@ -103,15 +103,14 @@ def test_herk_uses_fused_panel_spread(grid24):
     """The herk per-panel [MC,STAR]/[STAR,MR] pair must ride the fused
     panel_spread (one collective round), not the three-redistribute chain."""
     from elemental_tpu import VC
-    from elemental_tpu.redist import engine
 
     rng = _rng(44)
     n, k, nb = 12, 16, 8
     A = rng.normal(size=(n, k))
     Ad = _dist(grid24, A)
-    engine.REDIST_COUNTS.clear()
-    C = l3.herk("L", Ad, nb=nb)
-    counts = dict(engine.REDIST_COUNTS)
+    with _redist_counts() as counter:
+        C = l3.herk("L", Ad, nb=nb)
+    counts = dict(counter)
     npanels = -(-k // nb)
     assert counts.get("panel_spread") == npanels
     assert counts.get(((MC, MR), (VC, STAR))) == npanels

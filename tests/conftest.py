@@ -60,6 +60,17 @@ def grid24():
     return Grid(jax.devices(), height=2)
 
 
+@pytest.fixture
+def redist_counter():
+    """Scoped redistribute/panel_spread call counter: yields a fresh
+    Counter active for this test only (see engine.redist_counts) -- no
+    clear()-and-hope on the module global, no state leaking between
+    tests."""
+    from elemental_tpu.redist.engine import redist_counts
+    with redist_counts() as c:
+        yield c
+
+
 @pytest.fixture(scope="session")
 def grid42():
     return Grid(jax.devices(), height=4)

@@ -2,7 +2,7 @@
 import numpy as np
 import pytest
 
-from elemental_tpu import LEGAL_PAIRS, DistMatrix, from_global, to_global
+from elemental_tpu import LEGAL_PAIRS, from_global, to_global
 
 
 def checkerboard(m, n):

@@ -11,7 +11,6 @@ import numpy as np
 import pytest
 
 import elemental_tpu as el
-from elemental_tpu.core import environment as env
 
 
 class TestBlocksize:

@@ -1,7 +1,6 @@
 """Matrix-Market + Display/Spy IO (SURVEY.md §3.5 IO row completion)."""
 import os
 import numpy as np
-import pytest
 
 import elemental_tpu as el
 

@@ -7,10 +7,8 @@ device's slot range holds only its CRT-owned entries), the CIRC leaf
 lives on the root device only, and [MD,STAR] diagonal extraction
 allocates O(k/lcm) per device.
 """
-import math
 
 import numpy as np
-import jax
 import pytest
 
 import elemental_tpu as el

@@ -122,3 +122,57 @@ def test_contract_mc_star(grid24):
     B = shard_map(fn, mesh=grid24.mesh, in_specs=(A.spec,),
                   out_specs=out_meta.spec, check_vma=False)(A)
     np.testing.assert_allclose(np.asarray(to_global(B)), F, rtol=1e-12)
+
+
+# ---------------------------------------------------------------------
+# scoped call counting + dist-metadata trace hooks (ISSUE 3 satellites)
+# ---------------------------------------------------------------------
+
+def test_redist_counts_scoped_and_isolated(grid24):
+    """redist_counts() swaps a fresh counter in, readable during and
+    after the block; the enclosing counter never sees inner counts."""
+    from elemental_tpu import MC, MR, STAR
+
+    F = f(8, 8)
+    A = from_global(F, MC, MR, grid=grid24)
+    with engine.redist_counts() as outer:
+        redistribute(A, STAR, STAR)
+        assert sum(outer.values()) == 1
+        with engine.redist_counts() as inner:
+            redistribute(A, STAR, STAR)
+            redistribute(A, STAR, STAR)
+            assert sum(inner.values()) == 2        # live inside the block
+        assert sum(inner.values()) == 2            # and after it
+        assert sum(outer.values()) == 1            # no leak outward
+    assert engine.REDIST_COUNTS is not inner
+    # the backward-compatible module global still counts outside any scope
+    before = sum(engine.REDIST_COUNTS.values())
+    redistribute(A, STAR, STAR)
+    assert sum(engine.REDIST_COUNTS.values()) == before + 1
+
+
+def test_redist_counter_fixture(grid24, redist_counter):
+    """The pytest fixture wires the scoped counter through a test body."""
+    from elemental_tpu import MC, MR, STAR
+
+    A = from_global(f(8, 8), MC, MR, grid=grid24)
+    assert sum(redist_counter.values()) == 0
+    redistribute(A, STAR, STAR)
+    assert redist_counter[((MC, MR), (STAR, STAR))] == 1
+
+
+def test_redist_trace_records_metadata(grid24):
+    """redist_trace() captures per-call dist metadata with object
+    identities that prove data-flow adjacency (the analyzer's EL002
+    evidence)."""
+    from elemental_tpu import MC, MR, STAR, VC
+
+    A = from_global(f(12, 12), MC, MR, grid=grid24)
+    with engine.redist_trace() as log:
+        V = redistribute(A, VC, STAR)
+        redistribute(V, MC, MR)
+    assert [r.label for r in log] == ["[MC,MR]->[VC,STAR]",
+                                      "[VC,STAR]->[MC,MR]"]
+    assert log[0].gshape == (12, 12) and log[0].dtype == "float64"
+    assert log[1].in_id in log[0].out_ids          # fed back untouched
+    assert engine._REDIST_TRACE is None            # restored on exit

@@ -10,7 +10,6 @@ import pytest
 import elemental_tpu as el
 from elemental_tpu import MC, MR, from_global, to_global
 from elemental_tpu.matrices import hermitian_uniform_spectrum
-from elemental_tpu.blas.level1 import frobenius_norm
 
 
 @pytest.mark.parametrize("dtype", [np.float64, np.complex128])
@@ -156,16 +155,17 @@ def test_cholesky_crossover_boundary(grid24):
 def test_cholesky_panel_chain_uses_fused_spread(grid24, lookahead):
     """The [MC,STAR]/[STAR,MR] trailing-update pair must come from the ONE
     collective panel_spread fast path -- not from the three-redistribute
-    chain it replaced (pinned via the engine's trace-time call counts)."""
-    from elemental_tpu.redist import engine
+    chain it replaced (pinned via the engine's scoped trace-time call
+    counts)."""
+    from elemental_tpu.redist.engine import redist_counts
     from elemental_tpu import VC, STAR, MR
     n, nb = 32, 8
     A = hermitian_uniform_spectrum(n, 1, 10, grid24, dtype=np.float64,
                                    seed=20)
     F = np.asarray(to_global(A))
-    engine.REDIST_COUNTS.clear()
-    L = el.cholesky(A, nb=nb, lookahead=lookahead, crossover=0)
-    counts = dict(engine.REDIST_COUNTS)
+    with redist_counts() as counter:
+        L = el.cholesky(A, nb=nb, lookahead=lookahead, crossover=0)
+    counts = dict(counter)
     npanels = n // nb
     assert counts.get("panel_spread") == npanels - 1
     assert ((VC, STAR), (MC, STAR)) not in counts

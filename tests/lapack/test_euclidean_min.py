@@ -1,6 +1,5 @@
 """Ridge / Tikhonov / LSE / GLM oracles (closed-form cross-checks)."""
 import numpy as np
-import pytest
 
 import elemental_tpu as el
 

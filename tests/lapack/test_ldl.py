@@ -5,10 +5,9 @@ residual ||P A P^T - L D L^H|| / ||A|| on indefinite matrices (incl.
 pivot-stress cases), solve residuals, and Sylvester-law inertia counts.
 """
 import numpy as np
-import pytest
 
 import elemental_tpu as el
-from elemental_tpu.lapack.ldl import (ldl, ldl_solve_after, symmetric_solve,
+from elemental_tpu.lapack.ldl import (ldl, symmetric_solve,
                                       hermitian_solve, inertia)
 
 

@@ -100,11 +100,50 @@ def test_permute_rows_roundtrip(grid42):
 def test_lu_lookahead_matches_classic(grid24, shape):
     """The pipelined schedule reorders ops but computes the same update
     matmuls element-for-element: factors and pivots must agree with the
-    classic right-looking driver to roundoff."""
+    classic right-looking driver to roundoff (crossover disabled so both
+    run the full distributed loop)."""
     m, n = shape
     rng = np.random.default_rng(21)
     F = rng.normal(size=(m, n))
-    LUa, pa = lu(_dist(grid24, F), nb=8, lookahead=True)
+    LUa, pa = lu(_dist(grid24, F), nb=8, lookahead=True, crossover=0)
+    LUb, pb = lu(_dist(grid24, F), nb=8, lookahead=False)
+    np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
+    np.testing.assert_allclose(np.asarray(to_global(LUa)),
+                               np.asarray(to_global(LUb)),
+                               rtol=1e-12, atol=1e-12)
+
+
+@pytest.mark.parametrize("shape", [(48, 48), (40, 40), (48, 32), (32, 48)])
+def test_lu_crossover_boundary(grid24, shape):
+    """Tail crossover-to-local at thresholds just below / at / above the
+    remaining-block sizes: pivots match classic exactly and factors to
+    roundoff at every threshold (incl. 0 = never and huge = tail on the
+    first step)."""
+    m, n = shape
+    rng = np.random.default_rng(31)
+    F = rng.normal(size=(m, n))
+    LUref, pref = lu(_dist(grid24, F), nb=8, lookahead=False)
+    ref = np.asarray(to_global(LUref))
+    for xo in [0, 7, 8, 9, 16, 31, 32, 33, 10_000]:
+        LU, p = lu(_dist(grid24, F), nb=8, lookahead=True, crossover=xo)
+        np.testing.assert_array_equal(np.asarray(p), np.asarray(pref))
+        np.testing.assert_allclose(np.asarray(to_global(LU)), ref,
+                                   rtol=1e-12, atol=1e-12)
+        k = min(m, n)
+        got = np.asarray(to_global(LU))
+        L = np.tril(got[:, :k], -1) + np.eye(m, k)
+        U = np.triu(got[:k, :])
+        res = np.linalg.norm(F[np.asarray(p)] - L @ U)
+        assert res < 1e-12 * np.linalg.norm(F) * max(m, n)
+
+
+def test_lu_crossover_classic_opt_in(grid24):
+    """Explicit crossover also applies to the classic schedule (mirrors
+    cholesky): default classic never crosses over."""
+    n = 40
+    rng = np.random.default_rng(32)
+    F = rng.normal(size=(n, n))
+    LUa, pa = lu(_dist(grid24, F), nb=8, lookahead=False, crossover=16)
     LUb, pb = lu(_dist(grid24, F), nb=8, lookahead=False)
     np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
     np.testing.assert_allclose(np.asarray(to_global(LUa)),

@@ -7,7 +7,7 @@ import numpy as np
 import pytest
 
 import elemental_tpu as el
-from elemental_tpu import MC, MR, VC, STAR, from_global, to_global, redistribute
+from elemental_tpu import MC, MR, VC, STAR, from_global, to_global
 from elemental_tpu.lapack.qr import qr, apply_q, explicit_q, least_squares, tsqr
 
 

@@ -9,7 +9,6 @@ phase (n <= repl_max) and the distributed [MC,MR] phase (n > repl_max), and
 the herm_eig wiring end-to-end.
 """
 import numpy as np
-import pytest
 
 import elemental_tpu as el
 from elemental_tpu.lapack.tridiag_eig import tridiag_eig

@@ -4,7 +4,6 @@ Oracles: unimodularity of U, exact basis relation B_red = B U, the LLL
 conditions via the checker, and known short vectors.
 """
 import numpy as np
-import pytest
 
 import elemental_tpu as el
 

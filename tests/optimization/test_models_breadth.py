@@ -5,7 +5,6 @@ Oracles follow SURVEY.md §5: scipy/HiGHS objective agreement where an LP
 oracle exists, otherwise optimality conditions / known closed forms.
 """
 import numpy as np
-import pytest
 
 import elemental_tpu as el
 

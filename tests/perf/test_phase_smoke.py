@@ -42,7 +42,7 @@ def test_lu_phase_timer_schema_distributed(grid24, lookahead):
     F = rng.normal(size=(n, n)) + n * np.eye(n)
     A = el.from_global(F, el.MC, el.MR, grid=grid24)
     t = PhaseTimer()
-    LU, perm = el.lu(A, nb=nb, lookahead=lookahead, timer=t)
+    LU, perm = el.lu(A, nb=nb, lookahead=lookahead, crossover=0, timer=t)
     doc = json.loads(t.json(driver="lu", n=n, nb=nb, lookahead=lookahead))
     _check_schema(doc, n, nb, nsteps=n // nb)
     # the timed run is still a correct factorization
@@ -66,6 +66,28 @@ def test_lu_phase_timer_schema_local():
     LU, perm = el.lu(A, nb=nb, timer=t)
     doc = json.loads(t.json(driver="lu", n=n, nb=nb))
     _check_schema(doc, n, nb, nsteps=n // nb)
+
+
+def test_lu_phase_timer_tail_crossover(grid24):
+    """The LU crossover step attributes its gathered local finish to
+    'tail' (the ISSUE-3 rider mirroring the cholesky PR-2 tail)."""
+    from perf.phase_timer import PhaseTimer
+    n, nb = 48, 16
+    rng = np.random.default_rng(7)
+    F = rng.normal(size=(n, n)) + n * np.eye(n)
+    A = el.from_global(F, el.MC, el.MR, grid=grid24)
+    t = PhaseTimer()
+    LU, perm = el.lu(A, nb=nb, crossover=nb, timer=t)
+    doc = json.loads(t.json(driver="lu", n=n, nb=nb))
+    # steps 0 and 1 run distributed; the 16-wide tail crosses over at step 1
+    steps = doc["steps"]
+    assert [s["step"] for s in steps] == [0, 1]
+    assert "tail" in steps[-1] and "tail" in doc["totals"]
+    LUh = np.asarray(el.to_global(LU))
+    L = np.tril(LUh, -1) + np.eye(n)
+    U = np.triu(LUh)
+    p = np.asarray(perm)
+    assert np.linalg.norm(F[p, :] - L @ U) < 1e-11 * np.linalg.norm(F)
 
 
 def _spd(n, seed):

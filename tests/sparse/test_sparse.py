@@ -51,6 +51,30 @@ class TestDistMultiVec:
         with pytest.raises(ValueError):
             mv_remote_updates(v, [3], [2], [5.0])
 
+    def test_remote_updates_traced_indices(self, grid24):
+        """Traced (jit) indices skip the host-side bounds check via the
+        CONCRETE TracerArrayConversionError -- the validator must neither
+        swallow unrelated errors (the old bare ``except Exception``) nor
+        reject tracers."""
+        import jax
+        import jax.numpy as jnp
+        from elemental_tpu.core.multivec import _validate_update_indices
+
+        v = el.mv_zeros(10, 2, grid=grid24, dtype=np.float64)
+
+        @jax.jit
+        def upd(v, rows, cols, vals):
+            return mv_remote_updates(v, rows, cols, vals)
+
+        out = upd(v, jnp.array([3, 3]), jnp.array([0, 0]),
+                  jnp.array([1.0, 2.0]))
+        assert np.asarray(el.mv_to_global(out))[3, 0] == 3.0
+        # non-Tracer conversion failures now propagate instead of being
+        # silently treated as "traced"
+        with pytest.raises(ValueError):
+            _validate_update_indices(np.array([[1], [2]]),   # ragged object
+                                     [[3, 4], [5]], 10, 2, (10, 2))
+
     def test_distmatrix_bridges(self, grid24):
         X = np.arange(24.0).reshape(12, 2)
         v = el.mv_from_global(X, grid=grid24)

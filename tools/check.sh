@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# One-shot pre-commit gate (ISSUE 3): style lint + comm-plan lint +
+# golden comm-plan diff.  Run from anywhere; exits non-zero on ANY
+# finding.  Future PRs run this before committing -- it is the cheap
+# static slice of CI (seconds, no device execution); the full test suite
+# stays `python -m pytest tests/ -m 'not slow'`.
+#
+#   tools/check.sh          # everything
+#   tools/check.sh style    # ruff (or the stdlib fallback) only
+#   tools/check.sh comm     # comm-plan lint + golden diff only
+set -u
+cd "$(dirname "$0")/.."
+
+what="${1:-all}"
+rc=0
+
+if [ "$what" = "all" ] || [ "$what" = "style" ]; then
+    echo "== style lint =="
+    if command -v ruff >/dev/null 2>&1; then
+        ruff check . || rc=1
+    else
+        # container images without ruff: the stdlib AST fallback covers
+        # the highest-signal subset of the configured rules
+        python tools/pyflakes_lite.py || rc=1
+    fi
+fi
+
+if [ "$what" = "all" ] || [ "$what" = "comm" ]; then
+    echo "== comm-plan lint =="
+    python -m perf.comm_audit lint --all || rc=1
+    echo "== golden comm-plan diff =="
+    python -m perf.comm_audit diff --all || rc=1
+fi
+
+if [ "$rc" -eq 0 ]; then
+    echo "check.sh: all gates passed"
+else
+    echo "check.sh: FAILURES (see above)" >&2
+fi
+exit "$rc"
