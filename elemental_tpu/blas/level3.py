@@ -31,7 +31,7 @@ from jax import lax
 
 from ..core.dist import MC, MR, VC, VR, STAR
 from ..core.distmatrix import DistMatrix, zeros as dm_zeros
-from ..core.view import view, update_view, round_up
+from ..core.view import view, update_view
 from ..redist.engine import redistribute, transpose_dist, panel_spread
 from .level1 import _global_indices
 
@@ -45,12 +45,18 @@ def _check_mcmr(*Ms: DistMatrix):
             raise ValueError("operands on different grids")
 
 
-def _blocksize(nb: int | None, grain: int, extent: int) -> int:
-    if nb is None:
-        from ..core.environment import blocksize
-        nb = blocksize()
-    nb = round_up(max(nb, 1), grain)
-    return min(nb, round_up(max(extent, 1), grain))
+# The canonical blocksize rule lives in the tune subsystem (ISSUE 4);
+# re-exported here under its historical name for the lapack drivers that
+# import it from this module.
+from ..tune.policy import blocksize_policy as _blocksize  # noqa: E402
+
+
+def _resolve_auto(op: str, gshape, dtype, grid, **knobs) -> dict:
+    """Route any ``'auto'`` knob through the tuner (cache > cost model);
+    explicit values pass through untouched."""
+    from ..tune.policy import resolve_knobs
+    return resolve_knobs(op, gshape=gshape, dtype=dtype, grid=grid,
+                         knobs=knobs)
 
 
 def _orient(A: DistMatrix, orient: str) -> DistMatrix:
@@ -79,12 +85,16 @@ def _mask_triangle(C: DistMatrix, uplo: str, strict: bool = False):
 
 def gemm(A: DistMatrix, B: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None = None,
          orient_a: str = "N", orient_b: str = "N", alg: str = "auto",
-         nb: int | None = None, precision=None) -> DistMatrix:
+         nb: int | str | None = None, precision=None) -> DistMatrix:
     """C := alpha op(A) op(B) + beta C on [MC,MR] (SUMMA).
 
-    ``alg``: 'auto' keeps the largest operand stationary (the reference's
-    heuristic in ``Gemm.cpp``), or one of 'A' / 'B' / 'C' / 'gspmd'
-    ('gspmd' = single storage matmul, XLA chooses the schedule).
+    ``alg``: 'auto' routes through the tuning subsystem (measured-cache
+    winner first, else the closed-form ring-model cost comparison of the
+    SUMMA schedules -- the principled version of the reference's
+    largest-operand-stationary heuristic in ``Gemm.cpp``), or one of
+    'A' / 'B' / 'C' / 'dot' / 'gspmd' explicitly ('gspmd' = single
+    storage matmul, XLA chooses the schedule).  ``nb='auto'`` likewise
+    asks the tuner for the panel width; an explicit value always wins.
 
     Tiled ``BlockMatrix`` operands are accepted via read-proxy conversion
     (``DistMatrixReadProxy``): they re-lay out to [MC,MR] on entry; the
@@ -119,18 +129,10 @@ def gemm(A: DistMatrix, B: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None
         if C.gshape != (m, n):
             raise ValueError(f"C shape {C.gshape} != ({m},{n})")
 
-    if alg == "auto":
-        p = A.grid.size
-        # comm-volume comparison: Dot moves m*n*p (the replicated-C psum),
-        # the stationary schedules move ~k*(m+n) panel gathers -- Dot wins
-        # for small C with a long inner dimension (gemm::SUMMA_NNDot).
-        # STRICT inequality (square matmuls on p=2 hit equality) plus an
-        # absolute cap: Dot replicates C on every device.
-        if m * n * p < k * (m + n) and p > 1 and m * n <= (1 << 22):
-            alg = "dot"
-        else:
-            sizes = {"A": m * k, "B": k * n, "C": m * n}
-            alg = max(sizes, key=sizes.get)
+    if alg == "auto" or isinstance(nb, str):
+        kn = _resolve_auto("gemm", (m, k, n), C.dtype, A.grid,
+                           alg=alg, nb=nb)
+        alg, nb = kn["alg"], kn["nb"]
     if alg == "C":
         return _summa_c(alpha, A, B, beta, C, nb, precision)
     if alg == "A":
@@ -274,19 +276,22 @@ def trrk(uplo: str, alpha, A_mc: DistMatrix, B_mr: DistMatrix, beta, C: DistMatr
 
 
 def herk(uplo: str, A: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None = None,
-         orient: str = "N", nb: int | None = None, precision=None,
+         orient: str = "N", nb: int | str | None = None, precision=None,
          conj: bool = True) -> DistMatrix:
     """C(tri) := alpha op(A) op(A)^H + beta C(tri)  (orient 'N' or 'C'/'T').
 
     Per k-panel: A1 -> [VC,STAR], then the fused engine ``panel_spread``
     produces the [MC,STAR] panel and its [STAR,MR] adjoint in ONE
     collective round (the Cholesky trailing-update chain, cf.
-    ``cholesky::LVar3``); masked local update.
+    ``cholesky::LVar3``); masked local update.  ``nb='auto'`` asks the
+    tuning subsystem for the k-panel width.
     """
     if orient != "N":
         A = _orient(A, "C" if conj else "T")
     _check_mcmr(A)
     m, k = A.gshape
+    if isinstance(nb, str):
+        nb = _resolve_auto("herk", (m, k), A.dtype, A.grid, nb=nb)["nb"]
     r, c = A.grid.height, A.grid.width
     if C is None:
         C = dm_zeros(m, m, MC, MR, A.grid, dtype=A.dtype)
@@ -317,14 +322,17 @@ def syrk(uplo: str, A: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None = N
 # ---------------------------------------------------------------------
 
 def trsm(side: str, uplo: str, orient: str, A: DistMatrix, B: DistMatrix,
-         alpha=1.0, unit: bool = False, nb: int | None = None,
+         alpha=1.0, unit: bool = False, nb: int | str | None = None,
          precision=None) -> DistMatrix:
     """Solve op(A) X = alpha B (side 'L') or X op(A) = alpha B (side 'R');
     A triangular [MC,MR].  Reference: ``El::Trsm`` 8 side/uplo/orientation
     cases (``src/blas_like/level3/Trsm/*.hpp``).
 
-    Right-side solves reduce to left solves of the transposed system
-    (X op(A) = B  <=>  op(A)^T X^T = B^T)."""
+    ``nb='auto'`` asks the tuning subsystem for the panel width (explicit
+    values always win).  Right-side solves reduce to left solves of the
+    transposed system (X op(A) = B  <=>  op(A)^T X^T = B^T)."""
+    if isinstance(nb, str):
+        nb = _resolve_auto("trsm", B.gshape, B.dtype, B.grid, nb=nb)["nb"]
     trans = orient in ("T", "C")
     conj = orient == "C"
     if side.upper().startswith("R"):

@@ -234,9 +234,9 @@ def _local_cholesky(A: DistMatrix, nb: int | None, precision,
     return make_trapezoidal(A.with_local(out), "L")
 
 
-def cholesky(A: DistMatrix, uplo: str = "L", nb: int | None = None,
-             precision=None, lookahead: bool = True,
-             crossover: int | None = None, timer=None) -> DistMatrix:
+def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
+             precision=None, lookahead: bool | str = True,
+             crossover: int | str | None = None, timer=None) -> DistMatrix:
     """Cholesky factor of an HPD [MC,MR] matrix; reads only the ``uplo``
     triangle.  Returns L (A = L L^H) for 'L', U (A = U^H U) for 'U'.
 
@@ -246,8 +246,19 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | None = None,
     gathers the tail once and finishes locally (``None`` = :data:`_CROSSOVER`
     with look-ahead, disabled classic; 0 never crosses over); ``timer``
     enables eager per-phase wall-clock attribution (``perf/phase_timer.py``).
+
+    Any of ``nb`` / ``lookahead`` / ``crossover`` may be ``'auto'``: the
+    tuning subsystem resolves them per (shape, dtype, grid, backend) --
+    measured-cache winner first, analytic cost model cold (explicit
+    values always win; see ``elemental_tpu/tune``).
     """
     _check_mcmr(A)
+    if any(isinstance(v, str) for v in (nb, lookahead, crossover)):
+        from ..tune.policy import resolve_knobs
+        kn = resolve_knobs("cholesky", gshape=A.gshape, dtype=A.dtype,
+                           grid=A.grid, knobs={"nb": nb, "lookahead": lookahead,
+                                               "crossover": crossover})
+        nb, lookahead, crossover = kn["nb"], kn["lookahead"], kn["crossover"]
     if uplo.upper().startswith("U"):
         # U = (lower factor of A^H-as-lower)^H; A hermitian so the data of
         # the upper triangle, conj-transposed, is the lower triangle.

@@ -360,9 +360,9 @@ def _local_lu_array(a, m: int, n: int, ib: int, precision,
 _CROSSOVER = 4096
 
 
-def lu(A: DistMatrix, nb: int | None = None, precision=None,
-       update_precision=None, lookahead: bool = True,
-       crossover: int | None = None, timer=None):
+def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
+       update_precision=None, lookahead: bool | str = True,
+       crossover: int | str | None = None, timer=None):
     """Blocked right-looking LU with partial pivoting and look-ahead.
 
     Returns (LU, perm): LU holds unit-lower L below the diagonal and U on
@@ -380,8 +380,19 @@ def lu(A: DistMatrix, nb: int | None = None, precision=None,
     ``L21 @ U12``
     updates (e.g. ``lax.Precision.DEFAULT`` for bf16-MXU throughput at a
     documented ~1e-3 residual cost); ``timer`` enables eager per-phase
-    wall-clock attribution (see ``perf/phase_timer.py``)."""
+    wall-clock attribution (see ``perf/phase_timer.py``).
+
+    ``nb`` / ``lookahead`` / ``crossover`` accept ``'auto'``: the tuning
+    subsystem (``elemental_tpu/tune``) resolves them per (shape, dtype,
+    grid, backend) -- measured-cache winner first, analytic cost model
+    cold; explicit values always win."""
     _check_mcmr(A)
+    if any(isinstance(v, str) for v in (nb, lookahead, crossover)):
+        from ..tune.policy import resolve_knobs
+        kn = resolve_knobs("lu", gshape=A.gshape, dtype=A.dtype, grid=A.grid,
+                           knobs={"nb": nb, "lookahead": lookahead,
+                                  "crossover": crossover})
+        nb, lookahead, crossover = kn["nb"], kn["lookahead"], kn["crossover"]
     m, n = A.gshape
     g = A.grid
     if g.size == 1:

@@ -108,11 +108,24 @@ def _panel_v(Pf):
 # blocked Householder QR
 # ---------------------------------------------------------------------
 
-def qr(A: DistMatrix, nb: int | None = None, precision=None):
-    """Blocked Householder QR; returns (packed, tau) in geqrf format."""
+def qr(A: DistMatrix, nb: int | str | None = None, precision=None):
+    """Blocked Householder QR; returns (packed, tau) in geqrf format.
+
+    ``nb='auto'`` asks the tuning subsystem for the panel width.  The
+    resolved block size is ATTACHED to the returned packed matrix (the
+    ``_qr_nb`` attribute), so :func:`apply_q` called with ``nb=None``
+    reuses exactly the factorization's blocking and a mismatching
+    explicit ``nb`` raises instead of silently producing a wrong Q.  (The
+    attribute is host-side metadata: it does not survive a ``jax.jit``
+    boundary -- inside jit, pass the same ``nb`` to both ends as before.)
+    """
     _check_mcmr(A)
     m, n = A.gshape
     g = A.grid
+    if isinstance(nb, str):
+        from ..tune.policy import resolve_knobs
+        nb = resolve_knobs("qr", gshape=A.gshape, dtype=A.dtype, grid=g,
+                           knobs={"nb": nb})["nb"]
     r, c = g.height, g.width
     ib = _blocksize(nb, math.lcm(r, c), min(m, n))
     kend = min(m, n)
@@ -142,22 +155,55 @@ def qr(A: DistMatrix, nb: int | None = None, precision=None):
             upd = jnp.matmul(V_mc.local, W, precision=_hi(precision))
             A = _update_cols_ge(A, A2.with_local(A2.local - upd.astype(A.dtype)),
                                 (s, m), (s, n), e)
+    _record_qr_nb(A, ib)
     return A, jnp.concatenate(taus) if taus else jnp.zeros((0,), A.dtype)
 
 
+def _record_qr_nb(Ap: DistMatrix, ib: int) -> None:
+    """Attach the block size a factorization actually used to the packed
+    matrix (frozen dataclass => object.__setattr__).  Host-side metadata
+    only: lost across jit/pytree boundaries, where callers must keep
+    passing a consistent ``nb`` themselves."""
+    object.__setattr__(Ap, "_qr_nb", int(ib))
+
+
+def _applyq_blocksize(Ap: DistMatrix, nb, grain: int, kend: int) -> int:
+    """The blocking :func:`apply_q` must sweep with: default to the block
+    size recorded by :func:`qr`, and REFUSE a mismatching explicit ``nb``
+    (different panel boundaries silently produce a wrong Q)."""
+    rec = getattr(Ap, "_qr_nb", None)
+    if nb is None:
+        return rec if rec is not None else _blocksize(None, grain, kend)
+    if isinstance(nb, str):
+        from ..tune.policy import resolve_knobs
+        nb = resolve_knobs("qr", gshape=Ap.gshape, dtype=Ap.dtype,
+                           grid=Ap.grid, knobs={"nb": nb})["nb"]
+    ib = _blocksize(nb, grain, kend)
+    if rec is not None and ib != rec:
+        raise ValueError(
+            f"apply_q: nb={nb!r} derives block size {ib}, but this packed "
+            f"factor was produced by qr() with block size {rec}; pass "
+            "nb=None to reuse the factorization's blocking")
+    return ib
+
+
 def apply_q(Ap: DistMatrix, tau, B: DistMatrix, orient: str = "N",
-            nb: int | None = None, precision=None) -> DistMatrix:
+            nb: int | str | None = None, precision=None) -> DistMatrix:
     """B := Q B ('N') or Q^H B ('C'), Q from (packed, tau)
-    (``qr::ApplyQ`` / ``ApplyPackedReflectors``).  ``nb`` must match the
-    factorization's blocking (same default derivation)."""
+    (``qr::ApplyQ`` / ``ApplyPackedReflectors``).
+
+    ``nb`` MUST match the factorization's blocking.  The default
+    (``None``) reuses the block size :func:`qr` recorded on ``Ap``; an
+    explicit ``nb`` that derives different panel boundaries raises
+    ``ValueError`` instead of silently applying a wrong Q."""
     _check_mcmr(Ap, B)
     m, n = Ap.gshape
     if B.gshape[0] != m:
         raise ValueError(f"B height {B.gshape[0]} != {m}")
     g = Ap.grid
     r, c = g.height, g.width
-    ib = _blocksize(nb, math.lcm(r, c), min(m, n))
     kend = min(m, n)
+    ib = _applyq_blocksize(Ap, nb, math.lcm(r, c), kend)
     starts = list(range(0, kend, ib))
     if orient == "N":
         starts = starts[::-1]
@@ -352,6 +398,7 @@ def qr_col_piv(A: DistMatrix, nb: int | None = None, precision=None):
         blk = DistMatrix(P, (m - s, e_up - s), STAR, STAR, 0, 0, g)
         Ap = _update_cols_lt(Ap, redistribute(blk, MC, MR), (s, m),
                              (s, e_up), e)
+    _record_qr_nb(Ap, ib)
     return Ap, tau, jpvt
 
 

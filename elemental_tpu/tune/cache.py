@@ -1,0 +1,167 @@
+"""Persistent tuning cache: versioned ``tuning_cache/v1`` JSON entries.
+
+One JSON file per key under the cache directory; the key is
+``(op, shape-bucket, dtype, grid, backend)`` -- shape dims are bucketed to
+the next power of two so near-identical problems share an entry.  Layout:
+
+    ~/.cache/elemental_tpu/tuning/              (default; override with
+    $ELEMENTAL_TPU_TUNE_CACHE)
+      cholesky__b32768x32768__float32__g2x2__tpu.json
+
+    {"schema": "tuning_cache/v1",
+     "op": "cholesky", "bucket": [32768, 32768], "dtype": "float32",
+     "grid": [2, 2], "backend": "tpu",
+     "config": {"nb": 2048, "lookahead": true, "crossover": 4096},
+     "source": "measured",            # who wrote it (measured | manual)
+     "metric": {"seconds": ..., "tflops": ...},       # optional
+     "created": 1754300000.0}
+
+Writes are ATOMIC (same-directory temp file + ``os.replace``) so a crashed
+or concurrent ``perf.tune search`` never leaves a torn entry.  Reads are
+defensive: a missing file, unparsable JSON, a schema-version mismatch, or
+key fields that do not match the request all return ``None`` (the resolver
+then falls back to the cost model) -- a stale v0 cache can never steer a
+v1 library.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+import time
+
+SCHEMA = "tuning_cache/v1"
+
+#: environment override for the cache directory
+ENV_DIR = "ELEMENTAL_TPU_TUNE_CACHE"
+
+_DEFAULT_DIR = os.path.join("~", ".cache", "elemental_tpu", "tuning")
+
+
+def cache_dir() -> str:
+    """The active cache directory (env override first; not created here)."""
+    return os.path.expanduser(os.environ.get(ENV_DIR, _DEFAULT_DIR))
+
+
+def shape_bucket(dims) -> tuple:
+    """Per-dimension next-power-of-two bucket (>= 1)."""
+    return tuple(1 << max(0, int(d) - 1).bit_length() if d > 1 else 1
+                 for d in dims)
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheKey:
+    op: str
+    bucket: tuple
+    dtype: str
+    grid_shape: tuple
+    backend: str
+
+    def filename(self) -> str:
+        b = "x".join(str(d) for d in self.bucket)
+        r, c = self.grid_shape
+        return f"{self.op}__b{b}__{self.dtype}__g{r}x{c}__{self.backend}.json"
+
+    def path(self) -> str:
+        return os.path.join(cache_dir(), self.filename())
+
+
+def make_key(op: str, dims, dtype: str, grid_shape, backend: str) -> CacheKey:
+    return CacheKey(op=op, bucket=shape_bucket(dims), dtype=str(dtype),
+                    grid_shape=tuple(grid_shape), backend=str(backend))
+
+
+def save(key: CacheKey, config: dict, source: str = "measured",
+         metric: dict | None = None) -> str:
+    """Atomically persist a winner config for ``key``; returns the path."""
+    doc = {"schema": SCHEMA, "op": key.op, "bucket": list(key.bucket),
+           "dtype": key.dtype, "grid": list(key.grid_shape),
+           "backend": key.backend, "config": dict(config), "source": source,
+           "created": time.time()}
+    if metric:
+        doc["metric"] = dict(metric)
+    d = cache_dir()
+    os.makedirs(d, exist_ok=True)
+    path = key.path()
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tune_", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=False)
+            f.write("\n")
+        os.replace(tmp, path)            # atomic on POSIX
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def load(key: CacheKey) -> dict | None:
+    """The cached document for ``key``, or None when absent/invalid.
+
+    Rejected (returning None, never raising): unreadable or unparsable
+    files, a ``schema`` other than ``tuning_cache/v1``, and documents whose
+    op/bucket/dtype/grid/backend fields disagree with the key (e.g. a file
+    copied between machines or renamed by hand)."""
+    path = key.path()
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or doc.get("schema") != SCHEMA:
+        return None
+    if (doc.get("op") != key.op
+            or tuple(doc.get("bucket", ())) != key.bucket
+            or doc.get("dtype") != key.dtype
+            or tuple(doc.get("grid", ())) != key.grid_shape
+            or doc.get("backend") != key.backend
+            or not isinstance(doc.get("config"), dict)):
+        return None
+    return doc
+
+
+def entries() -> list:
+    """All valid cache documents currently on disk (sorted by filename)."""
+    d = cache_dir()
+    out = []
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return out
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(d, name)) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(doc, dict) and doc.get("schema") == SCHEMA:
+            doc["_file"] = name
+            out.append(doc)
+    return out
+
+
+def clear(op: str | None = None) -> int:
+    """Delete cache entries (all, or only those of ``op``); returns count."""
+    d = cache_dir()
+    removed = 0
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return 0
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        if op is not None and not name.startswith(f"{op}__"):
+            continue
+        try:
+            os.unlink(os.path.join(d, name))
+            removed += 1
+        except OSError:
+            pass
+    return removed

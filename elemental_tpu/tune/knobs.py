@@ -1,0 +1,160 @@
+"""Knob-space registry: which knobs each distributed driver exposes and
+which configurations of them are legal.
+
+One :class:`OpSpace` per tunable driver (``cholesky``, ``lu``, ``qr``,
+``gemm``, ``trsm``, ``herk``) describes
+
+  * the knob names the driver accepts as ``'auto'`` (``nb``, and for the
+    factorizations ``lookahead``/``crossover``, for gemm ``alg``),
+  * a candidate enumerator producing the LEGAL configurations for a
+    concrete problem context (shape, dtype, grid) -- grain-aligned ``nb``
+    ladders clamped to the extent, the replicated-C memory guard on
+    ``gemm(alg='dot')``, and so on.
+
+The registry is pure metadata: no jax import, no tracing, no device
+execution.  The cost model (:mod:`.cost_model`) scores these candidates;
+the resolver (:mod:`.policy`) picks one; explicit (non-``'auto'``) knob
+values pin their dimension of the product space and always win.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+
+from ..core.view import round_up
+
+#: the nb ladder every blocked driver sweeps; mirrors the A/B-measured
+#: ladder of ``perf/ab_harness.py`` (nb=2048 is the measured v5e winner at
+#: N=32k; small entries matter on CPU-sized problems and small grids)
+NB_LADDER = (64, 128, 256, 512, 1024, 2048, 4096)
+
+#: default tail crossover-to-local threshold of the look-ahead schedules
+#: (``lapack.cholesky._CROSSOVER`` == ``lapack.lu._CROSSOVER`` == 4096;
+#: kept literal here so the registry stays import-light -- re-pinned by
+#: ``tests/tune`` against the driver constants)
+DEFAULT_CROSSOVER = 4096
+
+#: replicated-C element cap for ``gemm(alg='dot')`` on p > 1 (the SUMMA-Dot
+#: schedule replicates the full C on every device; same guard the old
+#: in-driver heuristic used)
+DOT_ELEMENT_CAP = 1 << 22
+
+
+@dataclasses.dataclass(frozen=True)
+class TuneContext:
+    """The concrete problem a resolution runs against."""
+    op: str
+    dims: tuple            # driver dims: (n, n) / (m, n) / gemm (m, k, n)
+    dtype: str             # canonical dtype name ("float32", ...)
+    grid_shape: tuple      # (r, c)
+    backend: str           # "cpu" / "tpu" / "gpu"
+
+    @property
+    def grid_size(self) -> int:
+        r, c = self.grid_shape
+        return r * c
+
+    @property
+    def grain(self) -> int:
+        r, c = self.grid_shape
+        return math.lcm(r, c)
+
+    @property
+    def extent(self) -> int:
+        """The panel-sweep extent the nb ladder is clamped against."""
+        if self.op == "gemm":
+            return max(self.dims)
+        if self.op == "herk":
+            return self.dims[1]           # k-panel sweep
+        if self.op in ("cholesky", "trsm"):
+            return self.dims[0]           # row sweep
+        return min(self.dims)             # lu/qr: min(m, n) diagonal sweep
+
+
+def nb_candidates(ctx: TuneContext) -> tuple:
+    """Grain-aligned nb ladder clamped to the problem extent (plus the
+    extent/2 and extent/4 rungs so small problems still have a sweep)."""
+    grain = ctx.grain
+    cap = round_up(max(ctx.extent, 1), grain)
+    raw = list(NB_LADDER) + [cap, cap // 2, cap // 4]
+    vals = {min(round_up(max(v, grain), grain), cap) for v in raw if v >= 1}
+    return tuple(sorted(vals))
+
+
+def _factorization_space(ctx: TuneContext, pinned: dict) -> list:
+    nbs = (pinned["nb"],) if "nb" in pinned else nb_candidates(ctx)
+    las = (pinned["lookahead"],) if "lookahead" in pinned else (True, False)
+    xos = (pinned["crossover"],) if "crossover" in pinned \
+        else (DEFAULT_CROSSOVER, 0)
+    out = []
+    for nb, la, xo in itertools.product(nbs, las, xos):
+        if not la and xo not in (0, None):
+            continue                # classic never crosses over (driver default)
+        out.append({"nb": nb, "lookahead": la, "crossover": xo})
+    return out
+
+
+def _nb_only_space(ctx: TuneContext, pinned: dict) -> list:
+    nbs = (pinned["nb"],) if "nb" in pinned else nb_candidates(ctx)
+    return [{"nb": nb} for nb in nbs]
+
+
+#: gemm candidate order doubles as the deterministic tie-break: on a 1x1
+#: grid every alg has zero comm cost and 'dot' early-outs to ONE local
+#: matmul (the pinned ``_summa_dot`` p==1 fast path), so it leads.
+GEMM_ALGS = ("dot", "C", "A", "B", "gspmd")
+
+
+def _gemm_space(ctx: TuneContext, pinned: dict) -> list:
+    m, k, n = ctx.dims
+    algs = (pinned["alg"],) if "alg" in pinned else GEMM_ALGS
+    nbs = (pinned["nb"],) if "nb" in pinned else nb_candidates(ctx)
+    out = []
+    for alg in algs:
+        if alg == "dot" and ctx.grid_size > 1 and m * n > DOT_ELEMENT_CAP \
+                and "alg" not in pinned:
+            continue                      # replicated-C memory guard
+        for nb in nbs:
+            out.append({"alg": alg, "nb": nb})
+            if alg in ("dot", "gspmd"):
+                break                     # nb is dead for the one-shot algs
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class OpSpace:
+    """Registry entry: the knobs of one driver + its candidate enumerator."""
+    op: str
+    knobs: tuple                   # knob names accepted as 'auto'
+    space: callable                # (ctx, pinned) -> list[config dict]
+
+
+OPS = {
+    "cholesky": OpSpace("cholesky", ("nb", "lookahead", "crossover"),
+                        _factorization_space),
+    "lu": OpSpace("lu", ("nb", "lookahead", "crossover"),
+                  _factorization_space),
+    "qr": OpSpace("qr", ("nb",), _nb_only_space),
+    "gemm": OpSpace("gemm", ("alg", "nb"), _gemm_space),
+    "trsm": OpSpace("trsm", ("nb",), _nb_only_space),
+    "herk": OpSpace("herk", ("nb",), _nb_only_space),
+}
+
+
+def op_names() -> list:
+    return sorted(OPS)
+
+
+def candidate_configs(ctx: TuneContext, pinned: dict | None = None) -> list:
+    """All legal configurations of ``ctx.op`` with the ``pinned`` knobs
+    (explicit, non-'auto' values) frozen at their requested value."""
+    spec = OPS.get(ctx.op)
+    if spec is None:
+        raise KeyError(f"unknown tunable op {ctx.op!r}; known: {op_names()}")
+    pinned = dict(pinned or {})
+    unknown = set(pinned) - set(spec.knobs)
+    if unknown:
+        raise KeyError(f"{ctx.op} has no knob(s) {sorted(unknown)}; "
+                       f"knobs: {spec.knobs}")
+    return spec.space(ctx, pinned)

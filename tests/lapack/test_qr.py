@@ -152,3 +152,46 @@ def test_qr_col_piv_rank_revealing(grid24):
     Ap, tau, jpvt = qr_col_piv(A, nb=4)
     R = np.triu(np.asarray(el.to_global(Ap))[:12, :])
     assert abs(R[4, 4]) < 1e-10 * abs(R[0, 0])
+
+
+# ---------------------------------------------------------------------
+# ISSUE 4 satellite: the qr/apply_q blocking footgun is closed
+# ---------------------------------------------------------------------
+
+def test_apply_q_defaults_to_factorization_blocking(grid24):
+    """qr() records the block size it used; apply_q(nb=None) reuses it
+    even when the factorization ran with a NON-default nb (previously a
+    silent-wrong-results trap)."""
+    m, n, nrhs = 24, 16, 5
+    rng = np.random.default_rng(31)
+    F = rng.normal(size=(m, n))
+    B = rng.normal(size=(m, nrhs))
+    Ap, tau = qr(_dist(grid24, F), nb=8)      # non-default blocking
+    assert getattr(Ap, "_qr_nb") == 8
+    Bd = _dist(grid24, B)
+    out = apply_q(Ap, tau, apply_q(Ap, tau, Bd, orient="C"), orient="N")
+    np.testing.assert_allclose(np.asarray(to_global(out)), B, atol=1e-12)
+
+
+def test_apply_q_mismatched_nb_raises(grid24):
+    m, n = 24, 16
+    rng = np.random.default_rng(32)
+    Ap, tau = qr(_dist(grid24, rng.normal(size=(m, n))), nb=8)
+    Bd = _dist(grid24, rng.normal(size=(m, 3)))
+    with pytest.raises(ValueError, match="block size"):
+        apply_q(Ap, tau, Bd, nb=4)
+    # a matching explicit nb (same derived blocking) is still accepted
+    out = apply_q(Ap, tau, apply_q(Ap, tau, Bd, orient="C", nb=8), nb=8)
+    np.testing.assert_allclose(np.asarray(to_global(out)),
+                               np.asarray(to_global(Bd)), atol=1e-12)
+
+
+def test_qr_col_piv_records_blocking(grid24):
+    from elemental_tpu.lapack.qr import qr_col_piv
+    rng = np.random.default_rng(33)
+    Ap, tau, jpvt = qr_col_piv(_dist(grid24, rng.normal(size=(16, 12))),
+                               nb=4)
+    assert getattr(Ap, "_qr_nb") == 4
+    Bd = _dist(grid24, rng.normal(size=(16, 2)))
+    with pytest.raises(ValueError, match="block size"):
+        apply_q(Ap, tau, Bd, nb=12)

@@ -1,0 +1,121 @@
+"""Persistent tuning-cache behavior: round-trip, version/key rejection,
+atomic writes, env-var dir override, clear."""
+import json
+import os
+
+import pytest
+
+from elemental_tpu.tune import cache as tc
+
+
+@pytest.fixture
+def cache_env(tmp_path, monkeypatch):
+    """Point the cache at a fresh temp dir and drop the resolver memo."""
+    monkeypatch.setenv(tc.ENV_DIR, str(tmp_path))
+    from elemental_tpu.tune.policy import clear_memo
+    clear_memo()
+    yield tmp_path
+    clear_memo()
+
+
+def _key(op="cholesky", dims=(3000, 3000), dtype="float32",
+         grid=(2, 2), backend="cpu"):
+    return tc.make_key(op, dims, dtype, grid, backend)
+
+
+def test_round_trip(cache_env):
+    key = _key()
+    cfg = {"nb": 1024, "lookahead": True, "crossover": 4096}
+    path = tc.save(key, cfg, source="measured",
+                   metric={"seconds": 0.5, "tflops": 1.25})
+    assert os.path.dirname(path) == str(cache_env)
+    doc = tc.load(key)
+    assert doc is not None
+    assert doc["config"] == cfg
+    assert doc["source"] == "measured"
+    assert doc["schema"] == tc.SCHEMA
+    assert doc["metric"]["tflops"] == 1.25
+    # no torn/leftover temp files from the atomic write
+    leftovers = [f for f in os.listdir(cache_env) if f.endswith(".tmp")]
+    assert leftovers == []
+
+
+def test_shape_bucketing_shares_entries(cache_env):
+    """Dims bucket to the next power of two: 3000^2 and 4096^2 share a key."""
+    tc.save(_key(dims=(3000, 3000)), {"nb": 512})
+    assert tc.load(_key(dims=(4096, 4096)))["config"] == {"nb": 512}
+    assert tc.load(_key(dims=(4097, 4097))) is None      # next bucket
+    assert tc.shape_bucket((1, 2, 3, 64, 65)) == (1, 2, 4, 64, 128)
+
+
+def test_version_mismatch_rejected(cache_env):
+    key = _key()
+    tc.save(key, {"nb": 256})
+    path = key.path()
+    with open(path) as f:
+        doc = json.load(f)
+    doc["schema"] = "tuning_cache/v0"
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    assert tc.load(key) is None            # stale schema never steers v1
+
+
+def test_key_field_mismatch_rejected(cache_env):
+    """A file renamed/copied onto another key's path is rejected."""
+    a, b = _key(op="cholesky"), _key(op="lu")
+    tc.save(a, {"nb": 256})
+    os.replace(a.path(), b.path())
+    assert tc.load(b) is None
+    assert tc.load(a) is None              # and the original is gone
+
+
+def test_corrupt_file_rejected(cache_env):
+    key = _key()
+    os.makedirs(tc.cache_dir(), exist_ok=True)
+    with open(key.path(), "w") as f:
+        f.write("{not json")
+    assert tc.load(key) is None
+
+
+def test_clear_by_op(cache_env):
+    tc.save(_key(op="cholesky"), {"nb": 256})
+    tc.save(_key(op="lu"), {"nb": 512})
+    assert len(tc.entries()) == 2
+    assert tc.clear("cholesky") == 1
+    ops = [d["op"] for d in tc.entries()]
+    assert ops == ["lu"]
+    assert tc.clear() == 1
+    assert tc.entries() == []
+
+
+def test_resolver_prefers_cache_and_explicit_wins(cache_env):
+    """resolve(): empty cache -> cost model; measured entry -> cache; an
+    explicit knob is never overridden by either."""
+    import jax
+    import jax.numpy as jnp
+    from elemental_tpu import Grid
+    from elemental_tpu import tune
+
+    grid = Grid(jax.devices()[:4], height=2)
+    req = {"nb": "auto", "lookahead": "auto", "crossover": "auto"}
+    r0 = tune.resolve("cholesky", gshape=(64, 64), dtype=jnp.float32,
+                      grid=grid, requested=req)
+    assert r0.source == "cost_model"
+    assert isinstance(r0.config["nb"], int)
+
+    key = tc.make_key("cholesky", (64, 64), "float32", (2, 2), "cpu")
+    tc.save(key, {"nb": 32, "lookahead": False, "crossover": 0})
+    tune.clear_memo()
+    r1 = tune.resolve("cholesky", gshape=(64, 64), dtype=jnp.float32,
+                      grid=grid, requested=req)
+    assert r1.source == "cache"
+    assert r1.config == {"nb": 32, "lookahead": False, "crossover": 0}
+
+    # explicit always wins: nb pinned, only the 'auto' knobs resolve
+    kn = tune.resolve_knobs("cholesky", gshape=(64, 64), dtype=jnp.float32,
+                            grid=grid,
+                            knobs={"nb": 16, "lookahead": "auto",
+                                   "crossover": "auto"})
+    assert kn["nb"] == 16
+    assert kn["lookahead"] is False
+    assert kn["crossover"] == 0

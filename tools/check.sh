@@ -1,13 +1,16 @@
 #!/usr/bin/env bash
-# One-shot pre-commit gate (ISSUE 3): style lint + comm-plan lint +
-# golden comm-plan diff.  Run from anywhere; exits non-zero on ANY
-# finding.  Future PRs run this before committing -- it is the cheap
-# static slice of CI (seconds, no device execution); the full test suite
-# stays `python -m pytest tests/ -m 'not slow'`.
+# One-shot pre-commit gate (ISSUE 3 + 4): style lint + comm-plan lint +
+# golden comm-plan diff + autotuner cost-model self-check + the tier-1
+# tests/tune subset.  Run from anywhere; exits non-zero on ANY finding.
+# Future PRs run this before committing -- style/comm/explain are the
+# cheap static slice (no device execution); the tune tests execute small
+# factorizations on the virtual-CPU mesh (~a minute warm); the full test
+# suite stays `python -m pytest tests/ -m 'not slow'`.
 #
 #   tools/check.sh          # everything
 #   tools/check.sh style    # ruff (or the stdlib fallback) only
 #   tools/check.sh comm     # comm-plan lint + golden diff only
+#   tools/check.sh tune     # cost-model self-check + tests/tune only
 set -u
 cd "$(dirname "$0")/.."
 
@@ -30,6 +33,15 @@ if [ "$what" = "all" ] || [ "$what" = "comm" ]; then
     python -m perf.comm_audit lint --all || rc=1
     echo "== golden comm-plan diff =="
     python -m perf.comm_audit diff --all || rc=1
+fi
+
+if [ "$what" = "all" ] || [ "$what" = "tune" ]; then
+    echo "== autotuner cost-model self-check =="
+    # trace-only: exits non-zero if any candidate scores non-finite or the
+    # golden-geometry lookahead+crossover <= classic invariant breaks
+    python -m perf.tune explain cholesky || rc=1
+    echo "== tune tier-1 tests =="
+    python -m pytest tests/tune -q -m 'not slow' -p no:cacheprovider || rc=1
 fi
 
 if [ "$rc" -eq 0 ]; then
