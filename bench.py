@@ -21,6 +21,12 @@ the measured round-trip latency of a trivial op.
 diag/panel/update breakdown as a second ``phase_timings/v1`` JSON line
 after the headline (at a reduced N on TPU: the eager run holds more live
 buffers than the donate-input jit).
+
+The headline line embeds a versioned ``"obs"`` key (``obs_bench/v1``):
+the run's ``obs_metrics/v1`` document (op invocation counts, tuner cache
+events, phase histograms) plus the ``--phases`` totals -- the trail
+``tools/bench_diff.py`` gates and future perf PRs attribute against
+(ISSUE 5).
 """
 import json
 import sys
@@ -204,23 +210,8 @@ def main():
     except Exception as e:                     # never fail the benchmark
         tuner["error"] = f"{type(e).__name__}: {e}"
 
-    print(json.dumps({
-        "metric": f"cholesky_n{n_chol}_tflops_per_chip",
-        "value": round(chol_tflops, 3),
-        "unit": "TFLOP/s",
-        "vs_baseline": round(chol_tflops / north_star, 4),
-        "lu_metric": f"lu_n{n_lu}_tflops_per_chip",
-        "lu_value": round(lu_tflops, 3),
-        "lu_vs_baseline": round(lu_tflops / north_star, 4),
-        "vs_nameplate": round(chol_tflops / (0.6 * table_peak), 4),
-        "lu_vs_nameplate": round(lu_tflops / (0.6 * table_peak), 4),
-        "roofline_tflops": round(roofline, 2),
-        "nameplate_tflops": round(table_peak, 2),
-        "resid": f"{resid:.2e}",
-        "lu_resid": f"{lu_resid:.2e}",
-        "tuner": tuner,
-    }))
-
+    ph_line = None
+    ph_summary = None
     if "--phases" in sys.argv[1:]:
         # cholesky phase attribution alongside the headline: one eager run
         # through the PhaseTimer hook (smaller N on TPU -- the eager driver
@@ -241,9 +232,49 @@ def main():
         t = PhaseTimer()
         Lp = el.cholesky(Ap, nb=nb, precision=HI, timer=t)
         jax.block_until_ready(Lp.local)
-        print(t.json(driver="cholesky", n=n_ph, nb=nb, lookahead=True,
-                     flops=n_ph ** 3 / 3,
-                     device=getattr(dev, "device_kind", dev.platform)))
+        ph_doc = t.report(driver="cholesky", n=n_ph, nb=nb, lookahead=True,
+                          flops=n_ph ** 3 / 3,
+                          device=getattr(dev, "device_kind", dev.platform))
+        ph_line = json.dumps(ph_doc)
+        ph_summary = {"schema": ph_doc["schema"], "driver": "cholesky",
+                      "n": n_ph, "nb": nb, "totals": ph_doc["totals"],
+                      "total_seconds": ph_doc["total_seconds"]}
+        del Lp, Ap
+
+    # Observability doc (ISSUE 5): the run's metrics registry (op
+    # invocation counts, tuner cache events, phase histograms from the
+    # --phases run) plus the phase breakdown, under one versioned key --
+    # the machine-readable trail tools/bench_diff.py and future perf PRs
+    # read.  Collected defensively: observability must never fail a bench.
+    obs_doc: dict = {"schema": "obs_bench/v1"}
+    try:
+        from elemental_tpu.obs import metrics as obs_metrics
+        obs_doc["metrics"] = obs_metrics.current().to_doc(
+            device=getattr(dev, "device_kind", dev.platform))
+        obs_doc["phases"] = ph_summary
+    except Exception as e:                     # never fail the benchmark
+        obs_doc["error"] = f"{type(e).__name__}: {e}"
+
+    print(json.dumps({
+        "metric": f"cholesky_n{n_chol}_tflops_per_chip",
+        "value": round(chol_tflops, 3),
+        "unit": "TFLOP/s",
+        "vs_baseline": round(chol_tflops / north_star, 4),
+        "lu_metric": f"lu_n{n_lu}_tflops_per_chip",
+        "lu_value": round(lu_tflops, 3),
+        "lu_vs_baseline": round(lu_tflops / north_star, 4),
+        "vs_nameplate": round(chol_tflops / (0.6 * table_peak), 4),
+        "lu_vs_nameplate": round(lu_tflops / (0.6 * table_peak), 4),
+        "roofline_tflops": round(roofline, 2),
+        "nameplate_tflops": round(table_peak, 2),
+        "resid": f"{resid:.2e}",
+        "lu_resid": f"{lu_resid:.2e}",
+        "tuner": tuner,
+        "obs": obs_doc,
+    }))
+
+    if ph_line is not None:
+        print(ph_line)
     return 0
 
 

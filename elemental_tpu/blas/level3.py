@@ -32,6 +32,7 @@ from jax import lax
 from ..core.dist import MC, MR, VC, VR, STAR
 from ..core.distmatrix import DistMatrix, zeros as dm_zeros
 from ..core.view import view, update_view
+from ..obs.tracer import NULL_HOOK as _NULL_HOOK, phase_hook as _phase_hook
 from ..redist.engine import redistribute, transpose_dist, panel_spread
 from .level1 import _global_indices
 
@@ -133,14 +134,16 @@ def gemm(A: DistMatrix, B: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None
         kn = _resolve_auto("gemm", (m, k, n), C.dtype, A.grid,
                            alg=alg, nb=nb)
         alg, nb = kn["alg"], kn["nb"]
+    tm = _phase_hook("gemm", alg=alg)
+    tm.start()
     if alg == "C":
-        return _summa_c(alpha, A, B, beta, C, nb, precision)
+        return _summa_c(alpha, A, B, beta, C, nb, precision, tm)
     if alg == "A":
-        return _summa_a(alpha, A, B, beta, C, nb, precision)
+        return _summa_a(alpha, A, B, beta, C, nb, precision, tm)
     if alg == "B":
-        return _summa_b(alpha, A, B, beta, C, nb, precision)
+        return _summa_b(alpha, A, B, beta, C, nb, precision, tm)
     if alg == "dot":
-        return _summa_dot(alpha, A, B, beta, C, precision)
+        return _summa_dot(alpha, A, B, beta, C, precision, tm)
     if alg == "gspmd":
         # one-shot: re-land B's k-rows on A's k-col cyclic order ([MR,STAR]),
         # then a single storage matmul -- GSPMD inserts the psum over mr.
@@ -148,11 +151,13 @@ def gemm(A: DistMatrix, B: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None
         d = jnp.matmul(A.local, Bk.local, precision=precision)
         D = DistMatrix(d, (m, n), MC, STAR, 0, 0, A.grid)
         out = redistribute(D, MC, MR)
-        return C.with_local(_safe_astype(alpha * out.local + beta * C.local, C.dtype))
+        res = C.with_local(_safe_astype(alpha * out.local + beta * C.local, C.dtype))
+        tm.tick("panel", 0, res.local)
+        return res
     raise ValueError(f"unknown gemm alg {alg!r}")
 
 
-def _summa_c(alpha, A, B, beta, C, nb, precision):
+def _summa_c(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK):
     """Stationary-C (``gemm::SUMMA_NNC``): per k-panel, A1 -> [MC,STAR]
     (AllGather over mr), B1 -> [STAR,MR] (AllGather over mc), local MXU
     product accumulates into C's storage."""
@@ -161,15 +166,16 @@ def _summa_c(alpha, A, B, beta, C, nb, precision):
     r, c = A.grid.height, A.grid.width
     kb = _blocksize(nb, math.lcm(r, c), k)
     acc = beta * C.local if _nonzero(beta) else jnp.zeros_like(C.local)
-    for s in range(0, k, kb):
+    for i, s in enumerate(range(0, k, kb)):
         e = min(s + kb, k)
         A1 = redistribute(view(A, cols=(s, e)), MC, STAR)
         B1 = redistribute(view(B, rows=(s, e)), STAR, MR)
         acc = acc + alpha * jnp.matmul(A1.local, B1.local, precision=precision)
+        tm.tick("panel", i, acc)
     return C.with_local(_safe_astype(acc, C.dtype))
 
 
-def _summa_a(alpha, A, B, beta, C, nb, precision):
+def _summa_a(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK):
     """Stationary-A (``gemm::SUMMA_NNA``): per C column panel, B1 ->
     [MR,STAR]; the k-contraction is sharded over mr on both operands, so the
     storage matmul lowers to local product + psum over mr -> [MC,STAR]
@@ -179,7 +185,7 @@ def _summa_a(alpha, A, B, beta, C, nb, precision):
     r, c = A.grid.height, A.grid.width
     jb = _blocksize(nb, c, n)
     out = C.with_local(beta * C.local if _nonzero(beta) else jnp.zeros_like(C.local))
-    for s in range(0, n, jb):
+    for i, s in enumerate(range(0, n, jb)):
         e = min(s + jb, n)
         B1 = redistribute(view(B, cols=(s, e)), MR, STAR)
         d = jnp.matmul(A.local, B1.local, precision=precision)   # [MC,STAR] storage
@@ -188,10 +194,11 @@ def _summa_a(alpha, A, B, beta, C, nb, precision):
         cur = view(out, cols=(s, e))
         out = update_view(out, cur.with_local(cur.local + _safe_astype(alpha * panel.local, C.dtype)),
                           cols=(s, e))
+        tm.tick("panel", i, out.local)
     return out
 
 
-def _summa_b(alpha, A, B, beta, C, nb, precision):
+def _summa_b(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK):
     """Stationary-B: per C row panel, A1^T -> [MC,STAR] (so the k-contraction
     is sharded over mc on both operands); local product + psum over mc ->
     [STAR,MR] partial panel, filtered onto [MC,MR]."""
@@ -200,7 +207,7 @@ def _summa_b(alpha, A, B, beta, C, nb, precision):
     r, c = A.grid.height, A.grid.width
     ib = _blocksize(nb, r, m)
     out = C.with_local(beta * C.local if _nonzero(beta) else jnp.zeros_like(C.local))
-    for s in range(0, m, ib):
+    for i, s in enumerate(range(0, m, ib)):
         e = min(s + ib, m)
         A1T = redistribute(transpose_dist(view(A, rows=(s, e))), MC, STAR)
         d = jnp.matmul(A1T.local.T, B.local, precision=precision)  # [STAR,MR] storage
@@ -209,10 +216,11 @@ def _summa_b(alpha, A, B, beta, C, nb, precision):
         cur = view(out, rows=(s, e))
         out = update_view(out, cur.with_local(cur.local + _safe_astype(alpha * panel.local, C.dtype)),
                           rows=(s, e))
+        tm.tick("panel", i, out.local)
     return out
 
 
-def _summa_dot(alpha, A, B, beta, C, precision):
+def _summa_dot(alpha, A, B, beta, C, precision, tm=_NULL_HOOK):
     """SUMMA-Dot (``gemm::SUMMA_NNDot``, the small-C case): shard the
     inner dimension 1-D cyclic on BOTH operands ([STAR,VC] x [VC,STAR] --
     the same cyclic permutation on each side, so the storage matmul
@@ -232,9 +240,11 @@ def _summa_dot(alpha, A, B, beta, C, precision):
         dl = jnp.matmul(Avc.local, Bvc.local, precision=precision)
         D = DistMatrix(dl, (m, n), STAR, STAR, 0, 0, A.grid)
         d = redistribute(D, MC, MR).local
-    return C.with_local(_safe_astype(
+    res = C.with_local(_safe_astype(
         alpha * d + (beta * C.local if _nonzero(beta) else 0),
         C.dtype))
+    tm.tick("panel", 0, res.local)
+    return res
 
 
 def _nonzero(x) -> bool:
@@ -300,14 +310,18 @@ def herk(uplo: str, A: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None = N
         _check_mcmr(A, C)
         if C.gshape != (m, m):
             raise ValueError(f"C shape {C.gshape} != ({m},{m})")
+    tm = _phase_hook("herk")
+    tm.start()
     kb = _blocksize(nb, c, k)
     mask = _mask_triangle(C, uplo)
     acc = beta * C.local if _nonzero(beta) else jnp.zeros_like(C.local)
-    for s in range(0, k, kb):
+    for i, s in enumerate(range(0, k, kb)):
         e = min(s + kb, k)
         A1_vc = redistribute(view(A, cols=(s, e)), VC, STAR)
         A1_mc, A1H_mr = panel_spread(A1_vc, conj=conj)
+        tm.tick("spread", i, A1_mc.local, A1H_mr.local)
         acc = acc + alpha * jnp.matmul(A1_mc.local, A1H_mr.local, precision=precision)
+        tm.tick("update", i, acc)
     return C.with_local(jnp.where(mask, _safe_astype(acc, C.dtype), C.local))
 
 
@@ -333,18 +347,22 @@ def trsm(side: str, uplo: str, orient: str, A: DistMatrix, B: DistMatrix,
     transposed system (X op(A) = B  <=>  op(A)^T X^T = B^T)."""
     if isinstance(nb, str):
         nb = _resolve_auto("trsm", B.gshape, B.dtype, B.grid, nb=nb)["nb"]
+    tm = _phase_hook("trsm")
+    tm.start()
     trans = orient in ("T", "C")
     conj = orient == "C"
     if side.upper().startswith("R"):
         BT = redistribute(transpose_dist(B), MC, MR)
         # op(A)^T: N -> T; T -> N; C -> conj-only (trans=False, conj=True)
-        XT = _trsm_left(uplo, not trans, conj, A, BT, alpha, unit, nb, precision)
+        XT = _trsm_left(uplo, not trans, conj, A, BT, alpha, unit, nb,
+                        precision, tm)
         return redistribute(transpose_dist(XT), MC, MR)
-    return _trsm_left(uplo, trans, conj, A, B, alpha, unit, nb, precision)
+    return _trsm_left(uplo, trans, conj, A, B, alpha, unit, nb, precision, tm)
 
 
 def _trsm_left(uplo: str, trans: bool, conj: bool, A: DistMatrix, B: DistMatrix,
-               alpha, unit: bool, nb: int | None, precision) -> DistMatrix:
+               alpha, unit: bool, nb: int | None, precision,
+               tm=_NULL_HOOK) -> DistMatrix:
     """All eight left cases.  Effective triangle: uplo XOR trans decides the
     sweep direction; per panel the diagonal block is replicated
     ([STAR,STAR]), the RHS panel goes 1-D cyclic ([STAR,VR]) for the local
@@ -362,7 +380,7 @@ def _trsm_left(uplo: str, trans: bool, conj: bool, A: DistMatrix, B: DistMatrix,
     forward = lower != trans        # effective-lower => forward sweep
     if not forward:
         starts = starts[::-1]
-    for s in starts:
+    for k, s in enumerate(starts):
         e = min(s + ib, m)
         A11 = redistribute(view(A, rows=(s, e), cols=(s, e)), STAR, STAR)
         # mask to the stored triangle so opposite-triangle garbage (e.g. the
@@ -375,6 +393,7 @@ def _trsm_left(uplo: str, trans: bool, conj: bool, A: DistMatrix, B: DistMatrix,
         X1 = DistMatrix(x1, B1.gshape, STAR, VR, 0, 0, A.grid)
         X1_mr = redistribute(X1, STAR, MR)
         X = update_view(X, redistribute(X1_mr, MC, MR), rows=(s, e))  # local filter
+        tm.tick("solve", k, X.local)
         # trailing update of the not-yet-solved rows
         lo, hi = (e, m) if forward else (0, s)
         if lo >= hi:
@@ -390,6 +409,7 @@ def _trsm_left(uplo: str, trans: bool, conj: bool, A: DistMatrix, B: DistMatrix,
             a_loc = jnp.conj(a_loc)
         X = local_rank_update(X, a_loc, X1_mr.local, rows=(lo, hi),
                               precision=precision)
+        tm.tick("update", k, X.local)
     return X
 
 

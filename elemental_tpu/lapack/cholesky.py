@@ -63,7 +63,7 @@ from ..core.view import view, update_view
 from ..redist.engine import redistribute, transpose_dist, panel_spread
 from ..blas.level1 import make_trapezoidal, _global_indices
 from ..blas.level3 import _blocksize, _check_mcmr, _mask_triangle, trsm
-from .lu import _hi, _NULL_TIMER
+from .lu import _hi, _NULL_TIMER, _phase_hook
 
 #: Trailing-matrix size at which the distributed loop gathers the tail and
 #: finishes locally (look-ahead schedule only, unless overridden).  The
@@ -271,10 +271,10 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
     if A.gshape != (m, m):
         raise ValueError(f"cholesky needs square, got {A.gshape}")
     g = A.grid
-    tm = timer if timer is not None else _NULL_TIMER
+    tm = _phase_hook("cholesky", timer)
     tm.start()
     if g.size == 1:
-        return _local_cholesky(A, nb, precision, lookahead, timer)
+        return _local_cholesky(A, nb, precision, lookahead, tm)
     r, c = g.height, g.width
     ib = _blocksize(nb, math.lcm(r, c), m)
     xover = (_CROSSOVER if lookahead else 0) if crossover is None \

@@ -93,18 +93,10 @@ def _hi(precision):
     return precision if precision is not None else lax.Precision.HIGHEST
 
 
-class _NullTimer:
-    """Zero-overhead stand-in so the drivers can call tick() unconditionally."""
-    __slots__ = ()
-
-    def start(self):
-        pass
-
-    def tick(self, phase, step, *arrays):
-        pass
-
-
-_NULL_TIMER = _NullTimer()
+# The zero-overhead null tick hook and the driver-entry hook resolver now
+# live in the observability subsystem (ISSUE 5); the historical name is
+# kept for this module's importers (cholesky, tests).
+from ..obs.tracer import NULL_HOOK as _NULL_TIMER, phase_hook as _phase_hook
 
 
 # ---------------------------------------------------------------------
@@ -395,8 +387,9 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
         nb, lookahead, crossover = kn["nb"], kn["lookahead"], kn["crossover"]
     m, n = A.gshape
     g = A.grid
+    tm = _phase_hook("lu", timer)
     if g.size == 1:
-        return _local_lu(A, nb, precision, update_precision, lookahead, timer)
+        return _local_lu(A, nb, precision, update_precision, lookahead, tm)
     r, c = g.height, g.width
     ib = _blocksize(nb, math.lcm(r, c), min(m, n))
     kend = min(m, n)
@@ -404,7 +397,6 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
     upd = precision if update_precision is None else update_precision
     xover = (_CROSSOVER if lookahead else 0) if crossover is None \
         else max(int(crossover), 0)
-    tm = timer if timer is not None else _NULL_TIMER
     tm.start()
 
     def col_up(e):

@@ -32,7 +32,7 @@ from ..core.view import view, update_view
 from ..core.compat import shard_map
 from ..redist.engine import redistribute
 from ..blas.level3 import _blocksize, _check_mcmr, trsm
-from .lu import _update_cols_lt, _update_cols_ge, _hi
+from .lu import _update_cols_lt, _update_cols_ge, _hi, _phase_hook
 
 
 # ---------------------------------------------------------------------
@@ -108,7 +108,8 @@ def _panel_v(Pf):
 # blocked Householder QR
 # ---------------------------------------------------------------------
 
-def qr(A: DistMatrix, nb: int | str | None = None, precision=None):
+def qr(A: DistMatrix, nb: int | str | None = None, precision=None,
+       timer=None):
     """Blocked Householder QR; returns (packed, tau) in geqrf format.
 
     ``nb='auto'`` asks the tuning subsystem for the panel width.  The
@@ -118,6 +119,8 @@ def qr(A: DistMatrix, nb: int | str | None = None, precision=None):
     explicit ``nb`` raises instead of silently producing a wrong Q.  (The
     attribute is host-side metadata: it does not survive a ``jax.jit``
     boundary -- inside jit, pass the same ``nb`` to both ends as before.)
+    ``timer`` enables eager per-phase (panel/update) wall-clock
+    attribution, same protocol as ``lu``/``cholesky`` (ISSUE 5).
     """
     _check_mcmr(A)
     m, n = A.gshape
@@ -126,17 +129,20 @@ def qr(A: DistMatrix, nb: int | str | None = None, precision=None):
         from ..tune.policy import resolve_knobs
         nb = resolve_knobs("qr", gshape=A.gshape, dtype=A.dtype, grid=g,
                            knobs={"nb": nb})["nb"]
+    tm = _phase_hook("qr", timer)
+    tm.start()
     r, c = g.height, g.width
     ib = _blocksize(nb, math.lcm(r, c), min(m, n))
     kend = min(m, n)
     taus = []
-    for s in range(0, kend, ib):
+    for k, s in enumerate(range(0, kend, ib)):
         e = min(s + ib, kend)
         nbw = e - s
         e_up = min(-(-e // c) * c, n)
         panel = redistribute(view(A, rows=(s, m), cols=(s, e_up)), STAR, STAR)
         Pf, tau = _panel_qr(panel.local[:, :nbw])
         taus.append(tau)
+        tm.tick("panel", k, Pf, tau)
         if e_up > e:
             Pf_w = jnp.pad(Pf, ((0, 0), (0, e_up - e)))
         else:
@@ -155,6 +161,7 @@ def qr(A: DistMatrix, nb: int | str | None = None, precision=None):
             upd = jnp.matmul(V_mc.local, W, precision=_hi(precision))
             A = _update_cols_ge(A, A2.with_local(A2.local - upd.astype(A.dtype)),
                                 (s, m), (s, n), e)
+            tm.tick("update", k, A)
     _record_qr_nb(A, ib)
     return A, jnp.concatenate(taus) if taus else jnp.zeros((0,), A.dtype)
 

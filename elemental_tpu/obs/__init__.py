@@ -1,0 +1,41 @@
+"""Unified runtime observability (ISSUE 5): spans, metrics, exporters.
+
+Four pieces, one subsystem -- the layer every perf PR reports through:
+
+  :mod:`.tracer`       span tracer: ``Tracer`` (explicit nested spans via
+                       ``span()``, driver tick channels, engine collective
+                       observer) + :func:`phase_hook`, the one-line driver
+                       integration all six tuned drivers call
+  :mod:`.metrics`      counters / gauges / histograms ->
+                       ``obs_metrics/v1`` (op invocation counts,
+                       redistribute calls/bytes, tuning-cache events,
+                       phase-time histograms)
+  :mod:`.phase_timer`  ``PhaseTimer`` -- the historical per-phase
+                       attribution tool, now a shim over the tracer
+                       (``phase_timings/v1`` unchanged;
+                       ``perf.phase_timer`` re-exports from here)
+  :mod:`.export`       Chrome-trace/Perfetto ``trace.json`` rendering
+
+CLI: ``python -m perf.trace {run,summary,export}``.  Regression gate over
+the bench trajectory: ``tools/bench_diff.py`` (wired into
+``tools/check.sh``).
+"""
+from .metrics import (SCHEMA as METRICS_SCHEMA, MetricsRegistry, REGISTRY,
+                      current as current_metrics, scoped as metrics_scope,
+                      inc, observe, set_gauge)
+from .tracer import (TRACE_SCHEMA, CommEvent, NullHook, NULL_HOOK,
+                     PhaseRecord, Span, Tracer, active_tracer, phase_hook,
+                     ring_bytes)
+from .phase_timer import PHASES, SCHEMA as PHASE_TIMINGS_SCHEMA, PhaseTimer
+from .export import (CHROME_SCHEMA, chrome_trace_doc,
+                     phase_timings_to_chrome, write_json)
+
+__all__ = [
+    "METRICS_SCHEMA", "MetricsRegistry", "REGISTRY", "current_metrics",
+    "metrics_scope", "inc", "observe", "set_gauge",
+    "TRACE_SCHEMA", "CommEvent", "NullHook", "NULL_HOOK", "PhaseRecord",
+    "Span", "Tracer", "active_tracer", "phase_hook", "ring_bytes",
+    "PHASES", "PHASE_TIMINGS_SCHEMA", "PhaseTimer",
+    "CHROME_SCHEMA", "chrome_trace_doc", "phase_timings_to_chrome",
+    "write_json",
+]

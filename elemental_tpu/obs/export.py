@@ -1,0 +1,188 @@
+"""Exporters: Chrome-trace/Perfetto ``trace.json`` from a Tracer run.
+
+``chrome_trace_doc`` renders one :class:`~elemental_tpu.obs.tracer.Tracer`
+into the Chrome Trace Event JSON-object format, which Perfetto
+(https://ui.perfetto.dev) and chrome://tracing both load directly:
+
+  * spans are duration events (``ph: "X"``, micros since the run origin);
+  * the driver -> step -> phase nesting is laid out as ONE TRACK PER
+    PHASE LANE: track 0 carries the synthesized driver spans (one per
+    tick channel) plus any explicit ``tracer.span`` blocks, track 1 the
+    synthesized per-step spans, and each phase name gets its own track
+    (``diag``/``panel``/``swap``/... in the canonical PHASES order,
+    unseen names appended) so overlap between lanes is visible at a
+    glance -- the look-ahead schedule's whole point;
+  * collectives are instant events (``ph: "i"``) on a dedicated
+    ``collectives`` track, with src->dst label, global shape, dtype and
+    ring-model bytes in ``args``.
+
+The document carries a top-level ``"schema": "obs_chrome_trace/v1"`` key
+(Chrome/Perfetto ignore unknown keys in the object format) pinned by
+``tests/obs``; run metadata rides ``otherData``.
+
+``phase_timings_to_chrome`` converts a historical ``phase_timings/v1``
+document (``bench.py --phases`` / ``ab_harness.py phases`` output, which
+records durations but no timestamps) into the same trace format by laying
+the steps out sequentially -- ``python -m perf.trace export`` is the CLI.
+"""
+from __future__ import annotations
+
+import json
+
+from .phase_timer import PHASES, SCHEMA as PHASE_SCHEMA
+from .tracer import Tracer
+
+CHROME_SCHEMA = "obs_chrome_trace/v1"
+
+_PID = 0
+_TID_DRIVER = 0
+_TID_STEP = 1
+_FIRST_PHASE_TID = 2
+
+
+def _lanes(phase_names) -> dict:
+    """Stable phase-name -> tid map: canonical order first, extras after."""
+    lanes: dict = {}
+    tid = _FIRST_PHASE_TID
+    for p in PHASES:
+        if p in phase_names:
+            lanes[p] = tid
+            tid += 1
+    for p in sorted(phase_names):
+        if p not in lanes:
+            lanes[p] = tid
+            tid += 1
+    return lanes
+
+
+def _meta_events(lanes: dict, have_comms: bool) -> list:
+    evs = [{"ph": "M", "pid": _PID, "tid": _TID_DRIVER, "name": "thread_name",
+            "args": {"name": "drivers"}},
+           {"ph": "M", "pid": _PID, "tid": _TID_STEP, "name": "thread_name",
+            "args": {"name": "steps"}}]
+    for p, tid in sorted(lanes.items(), key=lambda kv: kv[1]):
+        evs.append({"ph": "M", "pid": _PID, "tid": tid, "name": "thread_name",
+                    "args": {"name": f"phase:{p}"}})
+    if have_comms:
+        evs.append({"ph": "M", "pid": _PID, "tid": _comm_tid(lanes),
+                    "name": "thread_name", "args": {"name": "collectives"}})
+    return evs
+
+
+def _comm_tid(lanes: dict) -> int:
+    return (max(lanes.values()) + 1) if lanes else _FIRST_PHASE_TID
+
+
+def chrome_trace_doc(tracer: Tracer, **meta) -> dict:
+    """Render a tracer's spans/phases/collectives as a Chrome trace."""
+    times = ([r.t0 for r in tracer.phases]
+             + [s.t0 for s in tracer.spans]
+             + [ev.t for ev in tracer.comms])
+    origin = min(times) if times else 0.0
+
+    def us(t: float) -> float:
+        return round((t - origin) * 1e6, 3)
+
+    lanes = _lanes({r.phase for r in tracer.phases})
+    events = _meta_events(lanes, bool(tracer.comms))
+
+    # synthesized driver spans (one per tick channel) on the driver track
+    for call, driver, t0, t1, steps in tracer.driver_calls():
+        events.append({"ph": "X", "pid": _PID, "tid": _TID_DRIVER,
+                       "name": driver, "ts": us(t0),
+                       "dur": round((t1 - t0) * 1e6, 3),
+                       "args": {"call": call, "steps": len(steps)}})
+    # explicit context-manager spans share the driver track (depth in args)
+    for s in tracer.spans:
+        t1 = s.t1 if s.t1 is not None else s.t0
+        events.append({"ph": "X", "pid": _PID, "tid": _TID_DRIVER,
+                       "name": s.name, "ts": us(s.t0),
+                       "dur": round((t1 - s.t0) * 1e6, 3),
+                       "args": {"depth": s.depth, **s.attrs}})
+    # synthesized step spans
+    steps_agg: dict = {}
+    for r in tracer.phases:
+        key = (r.call, r.step)
+        cur = steps_agg.get(key)
+        if cur is None:
+            steps_agg[key] = [r.driver, r.t0, r.t1]
+        else:
+            cur[1] = min(cur[1], r.t0)
+            cur[2] = max(cur[2], r.t1)
+    for (call, step), (driver, t0, t1) in sorted(steps_agg.items()):
+        events.append({"ph": "X", "pid": _PID, "tid": _TID_STEP,
+                       "name": f"{driver}[{step}]", "ts": us(t0),
+                       "dur": round((t1 - t0) * 1e6, 3),
+                       "args": {"call": call, "step": step}})
+    # phase spans, one lane per phase name
+    for r in tracer.phases:
+        events.append({"ph": "X", "pid": _PID, "tid": lanes[r.phase],
+                       "name": r.phase, "ts": us(r.t0),
+                       "dur": round(r.seconds * 1e6, 3),
+                       "args": {"driver": r.driver, "step": r.step,
+                                "call": r.call}})
+    # collective instants
+    ctid = _comm_tid(lanes)
+    for ev in tracer.comms:
+        events.append({"ph": "i", "s": "t", "pid": _PID, "tid": ctid,
+                       "name": ev.label, "ts": us(ev.t),
+                       "args": {"kind": ev.kind, "gshape": list(ev.gshape),
+                                "dtype": ev.dtype, "bytes": ev.bytes,
+                                "driver": ev.driver, "span": ev.span}})
+    return {"schema": CHROME_SCHEMA, "traceEvents": events,
+            "displayTimeUnit": "ms", "otherData": dict(meta)}
+
+
+def phase_timings_to_chrome(doc: dict, **meta) -> dict:
+    """Synthesize a Chrome trace from a ``phase_timings/v1`` document.
+
+    The phase-timings schema records per-(step, phase) DURATIONS but no
+    timestamps, so the steps are laid out back-to-back in listed order
+    (phases within a step in canonical order) -- lane structure and
+    relative widths are faithful, absolute placement is synthetic
+    (flagged in ``otherData.synthesized``)."""
+    if doc.get("schema") != PHASE_SCHEMA:
+        raise ValueError(f"expected a {PHASE_SCHEMA} document, got "
+                         f"schema={doc.get('schema')!r}")
+    driver = str(doc.get("driver", "driver"))
+    phase_names = set()
+    for srec in doc.get("steps", []):
+        phase_names |= set(srec) - {"step"}
+    lanes = _lanes(phase_names)
+    events = _meta_events(lanes, have_comms=False)
+    order = [p for p in PHASES if p in phase_names] \
+        + sorted(phase_names - set(PHASES))
+    t = 0.0
+    for srec in doc.get("steps", []):
+        step_t0 = t
+        for p in order:
+            if p not in srec:
+                continue
+            dur = float(srec[p])
+            events.append({"ph": "X", "pid": _PID, "tid": lanes[p],
+                           "name": p, "ts": round(t * 1e6, 3),
+                           "dur": round(dur * 1e6, 3),
+                           "args": {"driver": driver, "step": srec["step"]}})
+            t += dur
+        events.append({"ph": "X", "pid": _PID, "tid": _TID_STEP,
+                       "name": f"{driver}[{srec['step']}]",
+                       "ts": round(step_t0 * 1e6, 3),
+                       "dur": round((t - step_t0) * 1e6, 3),
+                       "args": {"step": srec["step"]}})
+    events.append({"ph": "X", "pid": _PID, "tid": _TID_DRIVER, "name": driver,
+                   "ts": 0.0, "dur": round(t * 1e6, 3),
+                   "args": {"total_seconds": doc.get("total_seconds")}})
+    other = {"synthesized": True,
+             "source_schema": PHASE_SCHEMA}
+    for k in ("driver", "n", "nb", "device", "lookahead"):
+        if k in doc:
+            other[k] = doc[k]
+    other.update(meta)
+    return {"schema": CHROME_SCHEMA, "traceEvents": events,
+            "displayTimeUnit": "ms", "otherData": other}
+
+
+def write_json(path: str, doc: dict) -> None:
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")

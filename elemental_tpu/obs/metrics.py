@@ -1,0 +1,160 @@
+"""Metrics registry: counters, gauges, histograms -> ``obs_metrics/v1``.
+
+The numeric half of the observability subsystem (the span half is
+:mod:`.tracer`).  One :class:`MetricsRegistry` holds three families:
+
+  * counters   -- monotonically increasing totals (driver invocation
+                  counts, redistribute calls/bytes, tuning-cache
+                  hit/miss/stale events);
+  * gauges     -- last-written values;
+  * histograms -- summary stats + a fixed log-ladder bucket table
+                  (phase wall-clock observations).
+
+Every series is keyed by (name, labels); labels are plain JSON-able
+scalars.  The process-global default registry (:data:`REGISTRY`) is what
+module-level :func:`inc` / :func:`observe` / :func:`set_gauge` write to;
+:func:`scoped` swaps a fresh registry in for a ``with`` block (the same
+isolation pattern as ``engine.redist_counts``), so tests and CLI runs
+read a clean slate without clearing global state.
+
+The JSON document (``obs_metrics/v1``) is STABLE -- pinned by
+``tests/obs`` -- and is what ``python -m perf.trace run`` emits and
+``bench.py`` embeds under its ``"obs"`` key::
+
+    {"schema": "obs_metrics/v1",
+     "counters":   [{"name": ..., "labels": {...}, "value": N}, ...],
+     "gauges":     [{"name": ..., "labels": {...}, "value": X}, ...],
+     "histograms": [{"name": ..., "labels": {...}, "count": N,
+                     "sum": S, "min": m, "max": M, "mean": S/N,
+                     "buckets": [{"le": sec|"+Inf", "count": cum}, ...]},
+                    ...],
+     ...caller metadata}
+
+Entries are sorted by (name, labels) so documents diff cleanly.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+
+SCHEMA = "obs_metrics/v1"
+
+#: histogram bucket upper bounds, seconds (log ladder; +Inf is implicit)
+BUCKETS = (1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0, 10.0, 100.0)
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted((str(k), v) for k, v in labels.items()))
+
+
+def _coerce(v):
+    """Labels must survive JSON round-trips losslessly."""
+    return v if isinstance(v, (str, int, float, bool)) or v is None else str(v)
+
+
+class MetricsRegistry:
+    """One in-process sink for counters/gauges/histograms."""
+
+    def __init__(self):
+        self._counters: dict = {}
+        self._gauges: dict = {}
+        self._hists: dict = {}      # key -> [count, sum, min, max, [bucket counts]]
+
+    # ---- writes ------------------------------------------------------
+    def inc(self, name: str, value: float = 1, **labels) -> None:
+        key = (name, _label_key(labels))
+        self._counters[key] = self._counters.get(key, 0) + value
+
+    def set_gauge(self, name: str, value: float, **labels) -> None:
+        self._gauges[(name, _label_key(labels))] = value
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        key = (name, _label_key(labels))
+        h = self._hists.get(key)
+        if h is None:
+            h = self._hists[key] = [0, 0.0, None, None, [0] * (len(BUCKETS) + 1)]
+        h[0] += 1
+        h[1] += value
+        h[2] = value if h[2] is None else min(h[2], value)
+        h[3] = value if h[3] is None else max(h[3], value)
+        for i, le in enumerate(BUCKETS):
+            if value <= le:
+                h[4][i] += 1
+                break
+        else:
+            h[4][-1] += 1
+
+    # ---- reads -------------------------------------------------------
+    def counter_value(self, name: str, **labels) -> float:
+        return self._counters.get((name, _label_key(labels)), 0)
+
+    def counters(self, name: str | None = None) -> dict:
+        """{(name, labels-tuple): value}, optionally filtered by name."""
+        return {k: v for k, v in self._counters.items()
+                if name is None or k[0] == name}
+
+    def to_doc(self, **meta) -> dict:
+        """The stable ``obs_metrics/v1`` document (meta merges at top level)."""
+        def rows(table):
+            out = []
+            for (name, lk), v in sorted(table.items(), key=lambda kv: repr(kv[0])):
+                out.append({"name": name,
+                            "labels": {k: _coerce(v2) for k, v2 in lk},
+                            "value": v})
+            return out
+
+        hists = []
+        for (name, lk), h in sorted(self._hists.items(), key=lambda kv: repr(kv[0])):
+            cum, buckets = 0, []
+            for le, cnt in zip(BUCKETS, h[4]):
+                cum += cnt
+                buckets.append({"le": le, "count": cum})
+            buckets.append({"le": "+Inf", "count": cum + h[4][-1]})
+            hists.append({"name": name,
+                          "labels": {k: _coerce(v) for k, v in lk},
+                          "count": h[0], "sum": h[1],
+                          "min": h[2], "max": h[3],
+                          "mean": (h[1] / h[0]) if h[0] else None,
+                          "buckets": buckets})
+        doc = {"schema": SCHEMA, "counters": rows(self._counters),
+               "gauges": rows(self._gauges), "histograms": hists}
+        doc.update(meta)
+        return doc
+
+    def to_json(self, indent: int | None = None, **meta) -> str:
+        return json.dumps(self.to_doc(**meta), indent=indent)
+
+
+#: the process-global default registry
+REGISTRY = MetricsRegistry()
+
+_CURRENT: MetricsRegistry = REGISTRY
+
+
+def current() -> MetricsRegistry:
+    """The registry module-level writes currently target."""
+    return _CURRENT
+
+
+@contextlib.contextmanager
+def scoped(registry: MetricsRegistry | None = None):
+    """Swap a fresh (or given) registry in for the block and yield it."""
+    global _CURRENT
+    prev = _CURRENT
+    _CURRENT = registry if registry is not None else MetricsRegistry()
+    try:
+        yield _CURRENT
+    finally:
+        _CURRENT = prev
+
+
+def inc(name: str, value: float = 1, **labels) -> None:
+    _CURRENT.inc(name, value, **labels)
+
+
+def set_gauge(name: str, value: float, **labels) -> None:
+    _CURRENT.set_gauge(name, value, **labels)
+
+
+def observe(name: str, value: float, **labels) -> None:
+    _CURRENT.observe(name, value, **labels)

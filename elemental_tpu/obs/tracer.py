@@ -1,0 +1,315 @@
+"""Runtime span tracer: nested spans, driver phase hooks, collective events.
+
+The structural half of the observability subsystem (ISSUE 5).  A
+:class:`Tracer` records three kinds of evidence from ONE eager run:
+
+  * explicit spans -- ``with tracer.span(name, sync=outputs, **attrs):``
+    context-manager blocks that nest via a stack; ``sync`` takes the
+    phase's output arrays and the span closes only after
+    ``jax.block_until_ready`` on them, so the recorded wall-clock is
+    honest under jax's async dispatch;
+  * phase records -- the driver hooks.  Every tuned driver (``cholesky``,
+    ``lu``, ``qr``, ``gemm``, ``trsm``, ``herk``) calls the PhaseTimer
+    tick protocol (``start()`` + ``tick(phase, step, *arrays)``) at its
+    phase boundaries; a tracer-backed :class:`_TickChannel` turns those
+    ticks into (driver, phase, step, t0, t1) records, from which the
+    exporter synthesizes the driver -> step -> phase span nesting.
+    ``tick`` blocks on the phase's outputs exactly like the original
+    ``perf.phase_timer.PhaseTimer`` (which is now a shim over this);
+  * collective events -- while a tracer is ACTIVE (``with tracer:``), it
+    registers an observer on the redistribution engine's trace hook, so
+    every public ``redistribute``/``panel_spread`` entry lands as an
+    instant event carrying src/dst distributions, global shape, dtype,
+    and a ring-model byte estimate, attributed to the innermost open
+    span / most recent driver.
+
+Activation (``with tracer:``) also makes the tracer the process-current
+one, so :func:`phase_hook` -- the single line each driver runs at entry
+-- routes the driver's ticks here without any driver-level plumbing.
+Like the PhaseTimer it generalizes, the tracer is an EAGER-mode tool:
+under ``jax.jit`` the ticks see tracers and degrade to no-ops (the
+driver fuses into one program and there are no phase boundaries to
+time).
+
+Metrics: unless constructed with ``metrics=False``, every phase record
+feeds a ``phase_seconds{driver,phase}`` histogram and every collective
+event bumps ``redist_calls{label}`` / ``redist_bytes{label}`` counters
+on the CURRENT :mod:`.metrics` registry; :func:`phase_hook` additionally
+counts ``op_calls{op}`` per driver entry (Python-entry counts, the same
+caveat as ``engine.REDIST_COUNTS``).
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+
+import jax
+import numpy as np
+
+from . import metrics as _metrics
+
+TRACE_SCHEMA = "obs_trace/v1"
+
+
+@dataclasses.dataclass
+class Span:
+    """One explicit (context-manager) span."""
+    name: str
+    t0: float
+    t1: float | None
+    depth: int
+    attrs: dict
+
+
+@dataclasses.dataclass
+class PhaseRecord:
+    """One driver phase interval reconstructed from a tick."""
+    driver: str
+    phase: str
+    step: int
+    t0: float
+    t1: float
+    call: int                    # driver-invocation ordinal (channel id)
+
+    @property
+    def seconds(self) -> float:
+        return self.t1 - self.t0
+
+
+@dataclasses.dataclass
+class CommEvent:
+    """One public redistribute/panel_spread entry observed at runtime."""
+    t: float
+    kind: str                    # "redistribute" | "panel_spread"
+    label: str                   # "[MC,MR]->[STAR,STAR]" | "panel_spread"
+    gshape: tuple
+    dtype: str
+    bytes: int                   # ring-model estimate (see ring_bytes)
+    span: str | None             # innermost open explicit span
+    driver: str | None           # most recent driver channel
+
+
+def ring_bytes(gshape, dtype, grid_shape) -> int:
+    """Ring-model per-device byte estimate for moving a ``gshape`` matrix
+    across a ``grid_shape`` mesh: each device receives the payload minus
+    its own shard, ``payload * (p - 1) / p`` (0 on a 1x1 grid -- no
+    collective executes).  The jaxpr-level analyzer
+    (``analysis.jaxpr_walk.estimate_bytes``) refines this per collective;
+    at the public-entry granularity recorded here the single formula is
+    the honest common denominator."""
+    try:
+        itemsize = np.dtype(dtype).itemsize
+    except TypeError:
+        itemsize = 4
+    payload = itemsize
+    for d in gshape:
+        payload *= int(d)
+    p = 1
+    for d in grid_shape:
+        p *= int(d)
+    if p <= 1:
+        return 0
+    return payload * (p - 1) // p
+
+
+class NullHook:
+    """Zero-overhead stand-in so drivers can call tick() unconditionally."""
+    __slots__ = ()
+
+    def start(self):
+        pass
+
+    def tick(self, phase, step, *arrays):
+        pass
+
+
+NULL_HOOK = NullHook()
+
+
+class _TickChannel:
+    """One driver invocation's tick stream (PhaseTimer protocol)."""
+    __slots__ = ("tracer", "driver", "attrs", "call", "_t")
+
+    def __init__(self, tracer: "Tracer", driver: str, call: int, attrs: dict):
+        self.tracer = tracer
+        self.driver = driver
+        self.attrs = attrs
+        self.call = call
+        self._t = None
+
+    def start(self):
+        """(Re)arm the clock at a driver's entry."""
+        self._t = self.tracer.clock()
+
+    def tick(self, phase, step, *arrays):
+        """Block on ``arrays`` and close the [previous-tick, now] phase."""
+        if arrays:
+            jax.block_until_ready(arrays)
+        now = self.tracer.clock()
+        t0 = self._t if self._t is not None else now
+        self.tracer._add_phase(self.driver, str(phase), int(step), t0, now,
+                               self.call)
+        self._t = now
+
+
+class _Fanout:
+    """Tick fan-out: an explicit PhaseTimer AND the active tracer both see
+    every tick (the first hook's block_until_ready makes the second ~free)."""
+    __slots__ = ("hooks",)
+
+    def __init__(self, hooks):
+        self.hooks = tuple(hooks)
+
+    def start(self):
+        for h in self.hooks:
+            h.start()
+
+    def tick(self, phase, step, *arrays):
+        for h in self.hooks:
+            h.tick(phase, step, *arrays)
+
+
+_ACTIVE: "Tracer | None" = None
+
+
+def active_tracer() -> "Tracer | None":
+    """The tracer currently activated via ``with tracer:``, if any."""
+    return _ACTIVE
+
+
+class Tracer:
+    """Collects spans, driver phase records, and collective events."""
+
+    def __init__(self, metrics: bool = True, clock=time.perf_counter):
+        self.clock = clock
+        self.spans: list[Span] = []
+        self.phases: list[PhaseRecord] = []
+        self.comms: list[CommEvent] = []
+        self._stack: list[Span] = []
+        self._metrics = metrics
+        self._ncalls = 0
+        self._cur_driver: str | None = None
+        self._prev_active: Tracer | None = None
+        self._unobserve = None
+
+    # ---- explicit spans ---------------------------------------------
+    @contextlib.contextmanager
+    def span(self, name: str, sync=None, **attrs):
+        """Open a nested span; if ``sync`` is given (arrays / pytree), the
+        span blocks on it before closing so the duration is honest."""
+        s = Span(name=str(name), t0=self.clock(), t1=None,
+                 depth=len(self._stack), attrs=dict(attrs))
+        self.spans.append(s)
+        self._stack.append(s)
+        try:
+            yield s
+        finally:
+            if sync is not None:
+                jax.block_until_ready(sync)
+            s.t1 = self.clock()
+            self._stack.pop()
+
+    # ---- driver tick channels ---------------------------------------
+    def channel(self, driver: str, **attrs) -> _TickChannel:
+        """A fresh tick channel; one per driver invocation."""
+        self._ncalls += 1
+        self._cur_driver = driver
+        return _TickChannel(self, driver, self._ncalls, attrs)
+
+    def _add_phase(self, driver, phase, step, t0, t1, call):
+        self.phases.append(PhaseRecord(driver, phase, step, t0, t1, call))
+        self._cur_driver = driver
+        if self._metrics:
+            _metrics.observe("phase_seconds", t1 - t0, driver=driver,
+                             phase=phase)
+
+    # ---- engine observer --------------------------------------------
+    def _on_redist(self, rec) -> None:
+        nbytes = ring_bytes(rec.gshape, rec.dtype,
+                            getattr(rec, "grid_shape", ()))
+        self.comms.append(CommEvent(
+            t=self.clock(), kind=rec.kind, label=rec.label,
+            gshape=tuple(rec.gshape), dtype=rec.dtype, bytes=nbytes,
+            span=self._stack[-1].name if self._stack else None,
+            driver=self._cur_driver))
+        if self._metrics:
+            _metrics.inc("redist_calls", label=rec.label)
+            _metrics.inc("redist_bytes", nbytes, label=rec.label)
+
+    # ---- activation --------------------------------------------------
+    def __enter__(self) -> "Tracer":
+        global _ACTIVE
+        from ..redist.engine import add_redist_observer
+        self._prev_active = _ACTIVE
+        _ACTIVE = self
+        self._unobserve = add_redist_observer(self._on_redist)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _ACTIVE
+        _ACTIVE = self._prev_active
+        self._prev_active = None
+        if self._unobserve is not None:
+            self._unobserve()
+            self._unobserve = None
+
+    # ---- aggregation -------------------------------------------------
+    def redist_counts(self) -> dict:
+        """{label: count} over the recorded collective events -- the
+        runtime twin of a ``comm_plan/v1`` document's ``redistributes``
+        table (tests cross-check the two against the goldens)."""
+        out: dict = {}
+        for ev in self.comms:
+            out[ev.label] = out.get(ev.label, 0) + 1
+        return dict(sorted(out.items()))
+
+    def redist_bytes_total(self) -> int:
+        return sum(ev.bytes for ev in self.comms)
+
+    def phase_totals(self) -> dict:
+        """{driver: {phase: seconds}} aggregated over all records."""
+        out: dict = {}
+        for r in self.phases:
+            d = out.setdefault(r.driver, {})
+            d[r.phase] = d.get(r.phase, 0.0) + r.seconds
+        return out
+
+    def driver_calls(self) -> list:
+        """[(call id, driver, t0, t1, steps)] synthesized from phase
+        records -- one entry per driver invocation (tick channel)."""
+        agg: dict = {}
+        for r in self.phases:
+            cur = agg.get(r.call)
+            if cur is None:
+                agg[r.call] = [r.call, r.driver, r.t0, r.t1, {r.step}]
+            else:
+                cur[2] = min(cur[2], r.t0)
+                cur[3] = max(cur[3], r.t1)
+                cur[4].add(r.step)
+        return [tuple(v[:4]) + (sorted(v[4]),)
+                for _, v in sorted(agg.items())]
+
+
+def phase_hook(driver: str, timer=None, **attrs):
+    """The one-line driver integration: resolve this invocation's tick
+    hook.  Counts the invocation (``op_calls{op=driver}`` on the current
+    metrics registry), then returns
+
+      * the explicit ``timer`` when no tracer is active (classic
+        PhaseTimer usage, unchanged),
+      * the active tracer's fresh channel when one is activated,
+      * a fan-out over both when both are present,
+      * the shared :data:`NULL_HOOK` when neither -- drivers stay
+        zero-overhead dead code under jit, exactly like the old
+        ``_NULL_TIMER``.
+    """
+    _metrics.inc("op_calls", op=driver)
+    tr = _ACTIVE
+    if tr is None:
+        return timer if timer is not None else NULL_HOOK
+    chan = tr.channel(driver, **attrs)
+    chan.start()
+    if timer is None:
+        return chan
+    return _Fanout((timer, chan))

@@ -95,6 +95,7 @@ class RedistRecord:
     dtype: str
     in_id: int           # id() of the source local array/tracer
     out_ids: tuple       # id() of the produced local array(s)/tracer(s)
+    grid_shape: tuple = ()   # (r, c) of the grid (obs ring-byte estimates)
     # live references keep the ids above unambiguous (no id reuse after GC)
     refs: tuple = dataclasses.field(default=(), repr=False, compare=False)
 
@@ -129,13 +130,38 @@ def redist_trace():
         _REDIST_TRACE = prev
 
 
-def _trace_record(kind, src, dst, gshape, dtype, objs_in, objs_out):
+#: runtime observers (``elemental_tpu.obs.Tracer`` activation registers
+#: one): callbacks invoked with every RedistRecord as it happens, whether
+#: or not a ``redist_trace`` block is also collecting.
+_REDIST_OBSERVERS: list = []
+
+
+def add_redist_observer(cb) -> callable:
+    """Register ``cb(record)`` on every public redistribute/panel_spread
+    entry; returns a zero-argument remover (idempotent)."""
+    _REDIST_OBSERVERS.append(cb)
+
+    def remove():
+        try:
+            _REDIST_OBSERVERS.remove(cb)
+        except ValueError:
+            pass
+    return remove
+
+
+def _trace_record(kind, src, dst, gshape, dtype, objs_in, objs_out,
+                  grid_shape=()):
+    if _REDIST_TRACE is None and not _REDIST_OBSERVERS:
+        return
+    rec = RedistRecord(
+        kind=kind, src=tuple(src), dst=tuple(dst), gshape=tuple(gshape),
+        dtype=str(dtype), in_id=id(objs_in),
+        out_ids=tuple(id(o) for o in objs_out), grid_shape=tuple(grid_shape),
+        refs=(objs_in,) + tuple(objs_out))
     if _REDIST_TRACE is not None:
-        _REDIST_TRACE.append(RedistRecord(
-            kind=kind, src=tuple(src), dst=tuple(dst), gshape=tuple(gshape),
-            dtype=str(dtype), in_id=id(objs_in),
-            out_ids=tuple(id(o) for o in objs_out),
-            refs=(objs_in,) + tuple(objs_out)))
+        _REDIST_TRACE.append(rec)
+    for cb in tuple(_REDIST_OBSERVERS):
+        cb(rec)
 
 
 # ---------------------------------------------------------------------
@@ -686,7 +712,8 @@ def panel_spread(A: DistMatrix, conj: bool = True):
     REDIST_COUNTS["panel_spread"] += 1
     mc, mr = _panel_spread_jit(A, conj)
     _trace_record("panel_spread", A.dist, ((MC, STAR), (STAR, MR)),
-                  A.gshape, A.dtype, A.local, (mc.local, mr.local))
+                  A.gshape, A.dtype, A.local, (mc.local, mr.local),
+                  grid_shape=(A.grid.height, A.grid.width))
     return mc, mr
 
 
@@ -784,7 +811,8 @@ def redistribute(A: DistMatrix, cdist: Dist, rdist: Dist,
     else:
         out = _redistribute_jit(A, cdist, rdist, calign, ralign)
     _trace_record("redistribute", A.dist, (cdist, rdist), A.gshape,
-                  A.dtype, A.local, (out.local,))
+                  A.dtype, A.local, (out.local,),
+                  grid_shape=(A.grid.height, A.grid.width))
     return out
 
 

@@ -21,7 +21,8 @@ from .knobs import (DEFAULT_CROSSOVER, GEMM_ALGS, NB_LADDER, OPS,
 from .cache import (SCHEMA as CACHE_SCHEMA, ENV_DIR as CACHE_ENV_DIR,
                     CacheKey, cache_dir, clear as clear_cache,
                     entries as cache_entries, load as cache_load,
-                    make_key, save as cache_save, shape_bucket)
+                    make_key, save as cache_save, scan as cache_scan,
+                    shape_bucket)
 from .policy import (Resolution, blocksize_policy, clear_memo, explain,
                      is_auto, resolve, resolve_knobs, wants_auto)
 
@@ -29,7 +30,8 @@ __all__ = [
     "DEFAULT_CROSSOVER", "GEMM_ALGS", "NB_LADDER", "OPS", "TuneContext",
     "candidate_configs", "nb_candidates", "op_names",
     "CACHE_SCHEMA", "CACHE_ENV_DIR", "CacheKey", "cache_dir", "clear_cache",
-    "cache_entries", "cache_load", "make_key", "cache_save", "shape_bucket",
+    "cache_entries", "cache_load", "make_key", "cache_save", "cache_scan",
+    "shape_bucket",
     "Resolution", "blocksize_policy", "clear_memo", "explain", "is_auto",
     "resolve", "resolve_knobs", "wants_auto",
 ]

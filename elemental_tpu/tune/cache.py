@@ -22,6 +22,13 @@ defensive: a missing file, unparsable JSON, a schema-version mismatch, or
 key fields that do not match the request all return ``None`` (the resolver
 then falls back to the cost model) -- a stale v0 cache can never steer a
 v1 library.
+
+Observability (ISSUE 5): every :func:`load` outcome is counted on the
+current metrics registry as ``tune_cache_events{op, event}`` with event
+one of ``hit`` / ``miss`` / ``unparsable`` / ``stale_schema`` /
+``key_mismatch`` (writes count as ``write``), and :func:`scan` reports
+per-file validity -- ``python -m perf.tune show`` surfaces both, so a
+silently rejected stale cache is no longer invisible.
 """
 from __future__ import annotations
 
@@ -30,6 +37,8 @@ import json
 import os
 import tempfile
 import time
+
+from ..obs import metrics as _metrics
 
 SCHEMA = "tuning_cache/v1"
 
@@ -96,6 +105,7 @@ def save(key: CacheKey, config: dict, source: str = "measured",
         except OSError:
             pass
         raise
+    _metrics.inc("tune_cache_events", op=key.op, event="write")
     return path
 
 
@@ -105,14 +115,20 @@ def load(key: CacheKey) -> dict | None:
     Rejected (returning None, never raising): unreadable or unparsable
     files, a ``schema`` other than ``tuning_cache/v1``, and documents whose
     op/bucket/dtype/grid/backend fields disagree with the key (e.g. a file
-    copied between machines or renamed by hand)."""
+    copied between machines or renamed by hand).  Each outcome is counted
+    as ``tune_cache_events{op, event}`` on the current metrics registry."""
     path = key.path()
     try:
         with open(path) as f:
             doc = json.load(f)
-    except (OSError, ValueError):
+    except OSError:
+        _metrics.inc("tune_cache_events", op=key.op, event="miss")
+        return None
+    except ValueError:
+        _metrics.inc("tune_cache_events", op=key.op, event="unparsable")
         return None
     if not isinstance(doc, dict) or doc.get("schema") != SCHEMA:
+        _metrics.inc("tune_cache_events", op=key.op, event="stale_schema")
         return None
     if (doc.get("op") != key.op
             or tuple(doc.get("bucket", ())) != key.bucket
@@ -120,30 +136,50 @@ def load(key: CacheKey) -> dict | None:
             or tuple(doc.get("grid", ())) != key.grid_shape
             or doc.get("backend") != key.backend
             or not isinstance(doc.get("config"), dict)):
+        _metrics.inc("tune_cache_events", op=key.op, event="key_mismatch")
         return None
+    _metrics.inc("tune_cache_events", op=key.op, event="hit")
     return doc
 
 
-def entries() -> list:
-    """All valid cache documents currently on disk (sorted by filename)."""
+def scan() -> tuple:
+    """(valid docs, rejects) across the whole cache directory.
+
+    Valid docs carry a ``_file`` key; rejects are ``{"file", "reason"}``
+    with reason ``unparsable`` / ``stale_schema`` (per-file validity for
+    ``perf.tune show`` -- the key-field check needs a request key, so a
+    renamed-but-well-formed file only surfaces as ``key_mismatch`` at
+    :func:`load` time).  Rejects are also counted on the metrics
+    registry."""
     d = cache_dir()
-    out = []
+    out, rejects = [], []
     try:
         names = sorted(os.listdir(d))
     except OSError:
-        return out
+        return out, rejects
     for name in names:
         if not name.endswith(".json"):
             continue
+        op = name.split("__", 1)[0]
         try:
             with open(os.path.join(d, name)) as f:
                 doc = json.load(f)
         except (OSError, ValueError):
+            rejects.append({"file": name, "reason": "unparsable"})
+            _metrics.inc("tune_cache_events", op=op, event="unparsable")
             continue
-        if isinstance(doc, dict) and doc.get("schema") == SCHEMA:
-            doc["_file"] = name
-            out.append(doc)
-    return out
+        if not isinstance(doc, dict) or doc.get("schema") != SCHEMA:
+            rejects.append({"file": name, "reason": "stale_schema"})
+            _metrics.inc("tune_cache_events", op=op, event="stale_schema")
+            continue
+        doc["_file"] = name
+        out.append(doc)
+    return out, rejects
+
+
+def entries() -> list:
+    """All valid cache documents currently on disk (sorted by filename)."""
+    return scan()[0]
 
 
 def clear(op: str | None = None) -> int:

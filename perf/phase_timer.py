@@ -1,93 +1,22 @@
-"""Reusable per-phase wall-clock attribution for blocked drivers.
+"""Compat shim: ``PhaseTimer`` now lives in ``elemental_tpu/obs/``.
 
-The observability half of the look-ahead LU/Cholesky work (ISSUEs 1-2): any
-driver that accepts a ``timer`` argument (today ``lapack.lu.lu`` and
-``lapack.cholesky.cholesky``, both grid and sequential paths) calls
-``timer.tick(phase, step, *arrays)`` at its phase boundaries.  The timer
-synchronizes on the phase's outputs (``jax.block_until_ready``) and charges
-the elapsed wall-clock since the previous tick to ``(phase, step)``, so a
-run yields a machine-readable panel / swap / solve / update breakdown per
-blocked step.
+The per-phase wall-clock attribution tool (ISSUEs 1-2) was folded into
+the unified observability subsystem (ISSUE 5); this module re-exports it
+so every historical import path keeps working unchanged::
 
-Usage (EAGER -- wrapping the driver in jit would fuse the phases away and
-make the ticks no-ops on tracers):
+    from perf.phase_timer import PhaseTimer, SCHEMA, PHASES
 
-    from perf.phase_timer import PhaseTimer
-    t = PhaseTimer()
-    LU, perm = el.lu(A, nb=2048, timer=t)
-    print(t.json(driver="lu", n=n, nb=2048))
-
-``python perf/ab_harness.py phases [lu|cholesky]`` is the CLI wrapper; the
-JSON schema is pinned by ``tests/perf/test_phase_smoke.py`` so the
-observability path cannot silently rot.  Schema (``phase_timings/v1``;
-LU emits panel/swap/solve/update, Cholesky diag/panel/spread/update and
-``tail`` on the crossover step)::
-
-    {"schema": "phase_timings/v1",
-     "steps":  [{"step": 0, "panel": s, "swap": s, "solve": s, "update": s},
-                ...],                      # seconds; phases may be absent
-     "totals": {"panel": s, "swap": s, "solve": s, "update": s},
-     "total_seconds": s,
-     ...caller metadata (driver, n, nb, device, ...)}
-
-Timing note: eager dispatch is asynchronous, so the sync INSIDE tick is
-what makes the attribution honest; each phase's time includes its share of
-dispatch overhead (the same caveat as any op-by-op profile).  Use the A/B
-modes of ``perf/ab_harness.py`` for end-to-end fused-program numbers.
+The ``phase_timings/v1`` schema is byte-identical (pinned by
+``tests/perf/test_phase_smoke.py``); ``PhaseTimer`` is now a thin wrapper
+over ``elemental_tpu.obs.Tracer`` -- see
+``elemental_tpu/obs/phase_timer.py`` for the full documentation, and
+``python -m perf.trace`` for the full-subsystem CLI (nested spans,
+collective events, Perfetto export, metrics).
 """
-from __future__ import annotations
+import os
+import sys
 
-import json
-import time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
-
-SCHEMA = "phase_timings/v1"
-
-#: canonical phase order for reports (drivers emit a subset: LU ticks
-#: panel/swap/solve/update, Cholesky diag/panel/spread/update + tail)
-PHASES = ("diag", "panel", "swap", "solve", "spread", "update", "tail")
-
-
-class PhaseTimer:
-    """Accumulates (phase, step, seconds) records from a driver's ticks."""
-
-    def __init__(self):
-        self.records: list[dict] = []
-        self._t = None
-
-    def start(self):
-        """(Re)arm the clock at a driver's entry."""
-        self._t = time.perf_counter()
-
-    def tick(self, phase, step, *arrays):
-        """Block on ``arrays`` and charge the elapsed time to (phase, step)."""
-        if arrays:
-            jax.block_until_ready(arrays)
-        now = time.perf_counter()
-        if self._t is None:
-            self._t = now
-        self.records.append({"phase": str(phase), "step": int(step),
-                             "seconds": now - self._t})
-        self._t = now
-
-    def report(self, **meta) -> dict:
-        """The schema dict above; ``meta`` keys merge at top level."""
-        steps: dict[int, dict] = {}
-        totals: dict[str, float] = {}
-        for r in self.records:
-            d = steps.setdefault(r["step"], {})
-            d[r["phase"]] = d.get(r["phase"], 0.0) + r["seconds"]
-            totals[r["phase"]] = totals.get(r["phase"], 0.0) + r["seconds"]
-        out = {
-            "schema": SCHEMA,
-            "steps": [{"step": k, **v} for k, v in sorted(steps.items())],
-            "totals": {p: totals[p] for p in PHASES if p in totals}
-            | {p: t for p, t in totals.items() if p not in PHASES},
-            "total_seconds": sum(totals.values()),
-        }
-        out.update(meta)
-        return out
-
-    def json(self, **meta) -> str:
-        return json.dumps(self.report(**meta))
+from elemental_tpu.obs.phase_timer import (  # noqa: E402,F401
+    PHASES, SCHEMA, PhaseTimer)

@@ -1,0 +1,255 @@
+"""Runtime tracing CLI (ISSUE 5): one eager driver run -> Perfetto trace
++ ``obs_metrics/v1`` document.
+
+The command-line face of ``elemental_tpu/obs``:
+
+    python -m perf.trace run cholesky 4096 --out trace.json
+                                            # trace one driver: nested
+                                            #   driver/step/phase spans +
+                                            #   collective instants ->
+                                            #   Chrome-trace JSON (load it
+                                            #   at https://ui.perfetto.dev)
+                                            #   + one obs_metrics/v1 line
+    python -m perf.trace run lu --n 256 --nb 64 --grid 2x2
+    python -m perf.trace summary trace.json # per-lane totals of a trace
+    python -m perf.trace export phases.json --out trace.json
+                                            # convert a phase_timings/v1
+                                            #   doc (bench.py --phases /
+                                            #   ab_harness.py phases) to
+                                            #   the same trace format
+
+Drivers: ``cholesky``, ``lu``, ``qr``, ``gemm``, ``trsm``, ``herk`` (the
+six tuned drivers -- all emit spans through ``obs.phase_hook``).  The run
+is EAGER (the tracer syncs at every phase boundary; same caveat as
+``PhaseTimer``) on the real backend; under ``JAX_PLATFORMS=cpu`` an
+8-virtual-device host mesh makes multi-device grids (``--grid 2x2``)
+available anywhere, which is what the ``tools/check.sh`` smoke uses.
+
+Flags for ``run``: ``--n N`` (or positional; default 2048 on TPU / 64 on
+CPU), ``--nb NB``, ``--grid RxC`` (default 2x2 when >= 4 devices, else
+1x1), ``--dtype NAME``, ``--alg {A,B,C,dot,gspmd,auto}`` (gemm),
+``--classic`` (lookahead off), ``--crossover X``, ``--out trace.json``,
+``--metrics-out metrics.json``.  The metrics document always prints to
+stdout as the final line; summary rows are ``#``-prefixed above it.
+"""
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DRIVERS = ("cholesky", "lu", "qr", "gemm", "trsm", "herk")
+
+
+def _bootstrap() -> None:
+    """Virtual 8-device mesh on CPU hosts, BEFORE jax initializes (the
+    backend itself is whatever the environment provides -- runtime traces
+    should see the real chip when there is one)."""
+    if _REPO not in sys.path:
+        sys.path.insert(0, _REPO)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except (AttributeError, RuntimeError):
+        pass      # older jax (XLA_FLAGS path) / backend already initialized
+
+
+def _grid(spec: str | None):
+    import jax
+    from elemental_tpu.core.grid import Grid
+    devs = jax.devices()
+    if spec is None:
+        if len(devs) >= 4:
+            return Grid(devs[:4], height=2)
+        return Grid(devs[:1])
+    r, c = (int(x) for x in spec.split("x"))
+    if r * c > len(devs):
+        raise SystemExit(f"grid {r}x{c} needs {r * c} devices, have {len(devs)}")
+    return Grid(devs[: r * c], height=r)
+
+
+def _run_driver(driver, grid, n, nb, lookahead, crossover, alg, dtype):
+    """Build inputs EAGERLY (outside the trace), run the driver once, and
+    return the output leaves (synced by the caller's span)."""
+    import numpy as np
+    import elemental_tpu as el
+    rng = np.random.default_rng(0)
+    F = rng.normal(size=(n, n)).astype(dtype)
+    kw = {}
+    if driver in ("cholesky", "lu"):
+        kw = {"lookahead": lookahead, "crossover": crossover}
+    if driver in ("cholesky", "trsm", "herk"):
+        S = (F @ F.T / n + n * np.eye(n)).astype(dtype)
+        A = el.from_global(S, el.MC, el.MR, grid=grid)
+    else:
+        A = el.from_global(F + n * np.eye(n, dtype=dtype), el.MC, el.MR,
+                           grid=grid)
+    if driver in ("gemm", "trsm"):
+        B = el.from_global(rng.normal(size=(n, n)).astype(dtype),
+                           el.MC, el.MR, grid=grid)
+    import jax
+    jax.block_until_ready(A.local)
+
+    if driver == "cholesky":
+        return el.cholesky(A, nb=nb, **kw).local
+    if driver == "lu":
+        LU, perm = el.lu(A, nb=nb, **kw)
+        return (LU.local, perm)
+    if driver == "qr":
+        Ap, tau = el.qr(A, nb=nb)
+        return (Ap.local, tau)
+    if driver == "gemm":
+        return el.gemm(A, B, alg=alg, nb=nb).local
+    if driver == "trsm":
+        return el.trsm("L", "L", "N", A, B, nb=nb).local
+    if driver == "herk":
+        return el.herk("L", A, nb=nb).local
+    raise SystemExit(f"unknown driver {driver!r}; known: {DRIVERS}")
+
+
+def cmd_run(driver, n, nb, grid_spec, dtype_name, alg, lookahead, crossover,
+            out, metrics_out) -> int:
+    import jax
+    from elemental_tpu import obs
+    grid = _grid(grid_spec)
+    if n is None:
+        n = 2048 if jax.devices()[0].platform != "cpu" else 64
+    meta = {"driver": driver, "n": n, "nb": nb,
+            "grid": f"{grid.height}x{grid.width}", "dtype": dtype_name,
+            "device": getattr(jax.devices()[0], "device_kind",
+                              jax.devices()[0].platform)}
+    with obs.metrics_scope() as reg:
+        tracer = obs.Tracer()
+        with tracer:
+            with tracer.span("run", **meta) as sp:
+                leaves = _run_driver(driver, grid, n, nb, lookahead,
+                                     crossover, alg, dtype_name)
+                jax.block_until_ready(leaves)
+        trace_doc = obs.chrome_trace_doc(tracer, **meta)
+        mdoc = reg.to_doc(**meta)
+    if out:
+        obs.write_json(out, trace_doc)
+        print(f"# trace: {out}  ({len(trace_doc['traceEvents'])} events; "
+              "load at https://ui.perfetto.dev)")
+    for drv, totals in tracer.phase_totals().items():
+        row = "  ".join(f"{p}={t * 1e3:.2f}ms" for p, t in totals.items())
+        print(f"# phases[{drv}]: {row}")
+    rc = tracer.redist_counts()
+    print(f"# collectives: {sum(rc.values())} redistribute/panel_spread "
+          f"entries, ~{tracer.redist_bytes_total()} ring-model bytes")
+    if metrics_out:
+        obs.write_json(metrics_out, mdoc)
+        print(f"# metrics: {metrics_out}")
+    print(json.dumps(mdoc))
+    return 0
+
+
+def cmd_summary(path) -> int:
+    with open(path) as f:
+        doc = json.load(f)
+    if "traceEvents" not in doc:
+        raise SystemExit(f"{path}: not a Chrome trace document")
+    names = {}
+    for ev in doc["traceEvents"]:
+        if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+            names[ev["tid"]] = ev["args"]["name"]
+    lanes: dict = {}
+    ninstant = nbytes = 0
+    for ev in doc["traceEvents"]:
+        if ev.get("ph") == "X":
+            lane = names.get(ev.get("tid"), str(ev.get("tid")))
+            cur = lanes.setdefault(lane, [0, 0.0])
+            cur[0] += 1
+            cur[1] += ev.get("dur", 0.0)
+        elif ev.get("ph") == "i":
+            ninstant += 1
+            nbytes += ev.get("args", {}).get("bytes", 0)
+    other = doc.get("otherData", {})
+    print(f"# {path}: schema={doc.get('schema')} "
+          + " ".join(f"{k}={v}" for k, v in sorted(other.items())))
+    print(f"{'lane':24s} {'spans':>6s} {'total_ms':>10s}")
+    for lane, (cnt, dur) in sorted(lanes.items(), key=lambda kv: -kv[1][1]):
+        print(f"{lane:24s} {cnt:6d} {dur / 1e3:10.3f}")
+    if ninstant:
+        print(f"{'collectives':24s} {ninstant:6d} {'~' + str(nbytes):>10s}B")
+    return 0
+
+
+def cmd_export(path, out) -> int:
+    from elemental_tpu import obs
+    with open(path) as f:
+        doc = json.load(f)
+    trace = obs.phase_timings_to_chrome(doc)
+    if out:
+        obs.write_json(out, trace)
+        print(f"# trace: {out}  ({len(trace['traceEvents'])} events)")
+    else:
+        print(json.dumps(trace))
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    cmd = argv.pop(0)
+    if cmd not in ("run", "summary", "export"):
+        print(__doc__)
+        raise SystemExit(f"unknown command {cmd!r}")
+    pos = []
+    n = nb = crossover = None
+    grid_spec = out = metrics_out = None
+    dtype_name, alg, lookahead = "float32", "auto", True
+    it = iter(argv)
+    for arg in it:
+        if arg == "--n":
+            n = int(next(it))
+        elif arg == "--nb":
+            nb = int(next(it))
+        elif arg == "--grid":
+            grid_spec = next(it)
+        elif arg == "--dtype":
+            dtype_name = next(it)
+        elif arg == "--alg":
+            alg = next(it)
+        elif arg == "--classic":
+            lookahead = False
+        elif arg == "--crossover":
+            crossover = int(next(it))
+        elif arg == "--out":
+            out = next(it)
+        elif arg == "--metrics-out":
+            metrics_out = next(it)
+        elif arg.startswith("--"):
+            raise SystemExit(f"unknown flag {arg!r}")
+        else:
+            pos.append(arg)
+    if cmd == "run":
+        if not pos:
+            raise SystemExit(f"run needs a driver ({'/'.join(DRIVERS)})")
+        driver = pos.pop(0)
+        if pos and n is None:
+            n = int(pos.pop(0))
+        _bootstrap()
+        return cmd_run(driver, n, nb, grid_spec, dtype_name, alg, lookahead,
+                       crossover, out, metrics_out)
+    if not pos:
+        raise SystemExit(f"{cmd} needs a JSON file path")
+    if cmd == "summary":
+        return cmd_summary(pos[0])
+    _bootstrap()          # export imports elemental_tpu.obs (jax)
+    return cmd_export(pos[0], out)
+
+
+if __name__ == "__main__":
+    try:
+        import signal
+        signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+    except (ImportError, AttributeError, ValueError):
+        pass
+    raise SystemExit(main())

@@ -159,15 +159,31 @@ def cmd_search(op, n, grid_spec, dtype_name, top, reps, dry_run) -> int:
 
 def cmd_show(op) -> int:
     from elemental_tpu import tune
-    docs = tune.cache_entries()
+    from elemental_tpu.obs import metrics as obs_metrics
+    docs, rejects = tune.cache_scan()
     if op:
         docs = [d for d in docs if d.get("op") == op]
-    print(f"# cache dir: {tune.cache_dir()}  ({len(docs)} entries)")
+        rejects = [r for r in rejects if r["file"].startswith(f"{op}__")]
+    print(f"# cache dir: {tune.cache_dir()}  ({len(docs)} entries, "
+          f"{len(rejects)} invalid)")
     for d in docs:
         metric = d.get("metric", {})
         extra = f"  {metric.get('tflops', 0):.3f} TFLOP/s" if metric else ""
         print(f"{d['_file']:64s} {_fmt_cfg(d['config'])} "
               f"[{d.get('source', '?')}]{extra}")
+    for r in rejects:
+        # a schema-mismatch file used to be rejected with zero visibility;
+        # now it is both printed here and counted on the metrics registry
+        print(f"INVALID {r['file']:56s} ({r['reason']}; ignored by the "
+              "resolver)")
+    events = obs_metrics.current().counters("tune_cache_events")
+    if events:
+        tally: dict = {}
+        for (_, labels), v in events.items():
+            ev = dict(labels).get("event", "?")
+            tally[ev] = tally.get(ev, 0) + v
+        row = "  ".join(f"{k}={int(v)}" for k, v in sorted(tally.items()))
+        print(f"# tune_cache_events (this process): {row}")
     return 0
 
 

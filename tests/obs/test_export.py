@@ -1,0 +1,144 @@
+"""Chrome-trace/Perfetto export schema pins + the perf.trace CLI
+(ISSUE 5).  The trace document must stay loadable by Perfetto: JSON
+object format, ``traceEvents`` with micros timestamps, complete ("X")
+and instant ("i") events, thread-name metadata per lane."""
+import json
+
+import pytest
+
+from elemental_tpu import obs
+
+
+class FakeClock:
+    def __init__(self, step=1.0):
+        self.t = 0.0
+        self.step = step
+
+    def __call__(self):
+        self.t += self.step
+        return self.t
+
+
+def _traced_run():
+    tr = obs.Tracer(metrics=False, clock=FakeClock())
+    with tr.span("run", driver="lu", n=64):
+        ch = tr.channel("lu")
+        ch.start()
+        ch.tick("panel", 0)
+        ch.tick("swap", 0)
+        ch.tick("update", 0)
+        ch.tick("panel", 1)
+        from elemental_tpu.core.dist import MC, MR, STAR
+        from elemental_tpu.redist.engine import RedistRecord
+        tr._on_redist(RedistRecord(
+            kind="redistribute", src=(MC, MR), dst=(STAR, STAR),
+            gshape=(64, 64), dtype="float32", in_id=1, out_ids=(2,),
+            grid_shape=(2, 2)))
+    return tr
+
+
+def test_chrome_trace_schema_pin():
+    tr = _traced_run()
+    doc = obs.chrome_trace_doc(tr, driver="lu", n=64)
+    json.loads(json.dumps(doc))                     # round-trippable
+    assert set(doc) == {"schema", "traceEvents", "displayTimeUnit",
+                        "otherData"}
+    assert doc["schema"] == obs.CHROME_SCHEMA == "obs_chrome_trace/v1"
+    assert doc["displayTimeUnit"] == "ms"
+    assert doc["otherData"] == {"driver": "lu", "n": 64}
+    phs = {ev["ph"] for ev in doc["traceEvents"]}
+    assert phs == {"M", "X", "i"}
+    for ev in doc["traceEvents"]:
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+        assert isinstance(ev["name"], str)
+        if ev["ph"] == "X":
+            assert ev["ts"] >= 0 and ev["dur"] >= 0
+        if ev["ph"] == "i":
+            assert ev["s"] == "t"
+            assert {"kind", "gshape", "dtype", "bytes"} <= set(ev["args"])
+
+
+def test_chrome_trace_one_track_per_phase_lane():
+    tr = _traced_run()
+    doc = obs.chrome_trace_doc(tr)
+    names = {ev["tid"]: ev["args"]["name"] for ev in doc["traceEvents"]
+             if ev["ph"] == "M" and ev["name"] == "thread_name"}
+    lanes = set(names.values())
+    assert {"drivers", "steps", "phase:panel", "phase:swap", "phase:update",
+            "collectives"} == lanes
+    # canonical phase ordering: panel lane before swap before update
+    by_name = {v: k for k, v in names.items()}
+    assert by_name["phase:panel"] < by_name["phase:swap"] \
+        < by_name["phase:update"]
+    # each phase record landed on its own lane
+    for ev in doc["traceEvents"]:
+        if ev["ph"] == "X" and ev["name"] in ("panel", "swap", "update"):
+            assert names[ev["tid"]] == f"phase:{ev['name']}"
+    # synthesized driver span + explicit run span share the driver track
+    driver_rows = [ev for ev in doc["traceEvents"]
+                   if ev["ph"] == "X" and names[ev["tid"]] == "drivers"]
+    assert {ev["name"] for ev in driver_rows} == {"lu", "run"}
+    # per-step spans cover their phases
+    steps = [ev for ev in doc["traceEvents"]
+             if ev["ph"] == "X" and names[ev["tid"]] == "steps"]
+    assert {ev["name"] for ev in steps} == {"lu[0]", "lu[1]"}
+
+
+def test_phase_timings_to_chrome():
+    ph = {"schema": "phase_timings/v1",
+          "steps": [{"step": 0, "panel": 0.25, "update": 0.75},
+                    {"step": 1, "panel": 0.5}],
+          "totals": {"panel": 0.75, "update": 0.75},
+          "total_seconds": 1.5, "driver": "cholesky", "n": 64, "nb": 16}
+    doc = obs.phase_timings_to_chrome(ph)
+    assert doc["schema"] == obs.CHROME_SCHEMA
+    assert doc["otherData"]["synthesized"] is True
+    assert doc["otherData"]["driver"] == "cholesky"
+    xs = [ev for ev in doc["traceEvents"] if ev["ph"] == "X"]
+    # sequential layout: step-0 panel at 0, update right after, step-1 next
+    phase_rows = [ev for ev in xs if ev["name"] in ("panel", "update")]
+    assert [(ev["ts"], ev["dur"]) for ev in phase_rows] == \
+        [(0.0, 0.25e6), (0.25e6, 0.75e6), (1e6, 0.5e6)]
+    driver_row = [ev for ev in xs if ev["name"] == "cholesky"]
+    assert driver_row and driver_row[0]["dur"] == 1.5e6
+
+
+def test_phase_timings_to_chrome_rejects_wrong_schema():
+    with pytest.raises(ValueError):
+        obs.phase_timings_to_chrome({"schema": "comm_plan/v1"})
+
+
+# ---------------------------------------------------------------------
+# perf.trace CLI (CPU-safe smoke; check.sh runs the same in-process)
+# ---------------------------------------------------------------------
+
+def test_perf_trace_run_summary_export(tmp_path, capsys):
+    from perf import trace as trace_cli
+    out = tmp_path / "trace.json"
+    mout = tmp_path / "metrics.json"
+    rc = trace_cli.cmd_run("cholesky", 64, 16, "1x1", "float32", "auto",
+                           True, None, str(out), str(mout))
+    assert rc == 0
+    stdout = capsys.readouterr().out
+    mdoc = json.loads(stdout.strip().splitlines()[-1])
+    assert mdoc["schema"] == "obs_metrics/v1"
+    ops = {c["labels"]["op"]: c["value"] for c in mdoc["counters"]
+           if c["name"] == "op_calls"}
+    assert ops.get("cholesky") == 1
+    tdoc = json.loads(out.read_text())
+    assert tdoc["schema"] == obs.CHROME_SCHEMA
+    assert any(ev.get("ph") == "X" for ev in tdoc["traceEvents"])
+    assert json.loads(mout.read_text())["schema"] == "obs_metrics/v1"
+    # summary reads the written trace back
+    assert trace_cli.cmd_summary(str(out)) == 0
+    summary = capsys.readouterr().out
+    assert "phase:" in summary and "drivers" in summary
+    # export converts a phase_timings doc into the same trace format
+    ph = tmp_path / "phases.json"
+    ph.write_text(json.dumps({
+        "schema": "phase_timings/v1", "driver": "lu",
+        "steps": [{"step": 0, "panel": 0.1}], "totals": {"panel": 0.1},
+        "total_seconds": 0.1}))
+    out2 = tmp_path / "trace2.json"
+    assert trace_cli.cmd_export(str(ph), str(out2)) == 0
+    assert json.loads(out2.read_text())["schema"] == obs.CHROME_SCHEMA

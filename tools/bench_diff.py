@@ -1,0 +1,166 @@
+#!/usr/bin/env python3
+"""Bench regression gate: compare a bench run against the BENCH_r*.json
+trajectory and exit non-zero on regression (ISSUE 5).
+
+The repo records one ``BENCH_rNN.json`` per PR round (the driver wraps
+``bench.py``'s single JSON line under a ``"parsed"`` key).  This tool
+makes that trajectory a GATE instead of an archive::
+
+    python tools/bench_diff.py --check BENCH_r05.json
+        # BENCH_r05 vs the best of BENCH_r01..r04 (same directory,
+        # lower round index); exit 1 if any gated metric regressed
+        # more than the threshold
+    python tools/bench_diff.py current.json BENCH_r04.json BENCH_r03.json
+        # explicit current-vs-baselines comparison (current.json may be
+        # the wrapped form or a raw bench.py output line)
+
+Gated metrics default to the ROOFLINE-NORMALIZED ratios ``vs_baseline``
+(cholesky) and ``lu_vs_baseline`` -- raw TFLOP/s on shared/tunneled chips
+swings ~2x run to run (see bench.py), while the in-run-roofline ratio
+isolates algorithmic regressions from chip weather.  Override with one
+or more ``--metric NAME`` (e.g. ``--metric value`` for raw cholesky
+TFLOP/s, ``--metric lu_value``).
+
+Thresholds: ``--threshold 0.10`` sets the global relative-drop tolerance
+(default 10%); ``--threshold NAME=X`` pins a per-metric override (both
+forms may repeat).  A metric regresses when
+
+    current < (1 - threshold) * max(baselines)
+
+i.e. the gate compares against the BEST recorded value, so a slow decay
+across rounds cannot ratchet the bar down.  Metrics absent from the
+current run or from every baseline are skipped with a note (older rounds
+predate some metrics).  Stdlib-only: no jax import, safe anywhere.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+DEFAULT_METRICS = ("vs_baseline", "lu_vs_baseline")
+DEFAULT_THRESHOLD = 0.10
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+
+def load_doc(path: str) -> dict:
+    """The bench metric dict of one file (unwraps the driver's record)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: not a JSON object")
+    return doc
+
+
+def round_index(path: str):
+    m = _ROUND_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def trajectory_before(path: str) -> list:
+    """Sibling BENCH_r*.json files with a strictly lower round index."""
+    idx = round_index(path)
+    if idx is None:
+        raise SystemExit(f"--check {path}: expected a *_rNN.json filename")
+    d = os.path.dirname(os.path.abspath(path))
+    out = []
+    for cand in sorted(glob.glob(os.path.join(d, "BENCH_r*.json"))):
+        ci = round_index(cand)
+        if ci is not None and ci < idx:
+            out.append(cand)
+    return out
+
+
+def compare(current: dict, baselines: list, metrics, thresholds) -> list:
+    """[(metric, current, best, baseline_file, threshold, regressed)] for
+    every gated metric comparable on both sides."""
+    rows = []
+    for name in metrics:
+        cur = current.get(name)
+        if not isinstance(cur, (int, float)):
+            continue
+        best, src = None, None
+        for path, doc in baselines:
+            v = doc.get(name)
+            if isinstance(v, (int, float)) and (best is None or v > best):
+                best, src = v, path
+        if best is None:
+            continue
+        thr = thresholds.get(name, thresholds.get(None, DEFAULT_THRESHOLD))
+        regressed = cur < (1.0 - thr) * best
+        rows.append((name, cur, best, src, thr, regressed))
+    return rows
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    check = None
+    paths = []
+    metrics: list = []
+    thresholds: dict = {None: DEFAULT_THRESHOLD}
+    it = iter(argv)
+    for arg in it:
+        if arg == "--check":
+            check = next(it)
+        elif arg == "--metric":
+            metrics.append(next(it))
+        elif arg == "--threshold":
+            v = next(it)
+            if "=" in v:
+                name, x = v.split("=", 1)
+                thresholds[name] = float(x)
+            else:
+                thresholds[None] = float(v)
+        elif arg.startswith("--"):
+            raise SystemExit(f"unknown flag {arg!r}")
+        else:
+            paths.append(arg)
+    if check is not None:
+        current_path = check
+        baseline_paths = trajectory_before(check)
+    else:
+        if len(paths) < 2:
+            raise SystemExit("need --check FILE or CURRENT BASELINE...")
+        current_path, baseline_paths = paths[0], paths[1:]
+    current = load_doc(current_path)
+    baselines = [(p, load_doc(p)) for p in baseline_paths]
+    if not baselines:
+        print(f"bench_diff: no baselines before {current_path}; nothing to gate")
+        return 0
+    gated = metrics or list(DEFAULT_METRICS)
+    rows = compare(current, baselines, gated, thresholds)
+    print(f"# current: {current_path}   baselines: "
+          f"{', '.join(os.path.basename(p) for p in baseline_paths)}")
+    print(f"{'metric':20s} {'current':>10s} {'best':>10s} {'delta':>8s} "
+          f"{'thresh':>7s}  {'best from'}")
+    failed = 0
+    for name, cur, best, src, thr, regressed in rows:
+        delta = (cur - best) / best if best else 0.0
+        flag = "  REGRESSION" if regressed else ""
+        print(f"{name:20s} {cur:10.4f} {best:10.4f} {delta:+7.1%} "
+              f"{thr:7.0%}  {os.path.basename(src)}{flag}")
+        failed += bool(regressed)
+    skipped = [m for m in gated if m not in {r[0] for r in rows}]
+    if skipped:
+        print(f"# skipped (absent on one side): {', '.join(skipped)}")
+    if not rows:
+        print("bench_diff: no comparable metrics; nothing gated")
+        return 0
+    if failed:
+        print(f"bench_diff: {failed} metric(s) regressed beyond threshold",
+              file=sys.stderr)
+        return 1
+    print("bench_diff: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,16 +1,19 @@
 #!/usr/bin/env bash
-# One-shot pre-commit gate (ISSUE 3 + 4): style lint + comm-plan lint +
+# One-shot pre-commit gate (ISSUE 3 + 4 + 5): style lint + comm-plan lint +
 # golden comm-plan diff + autotuner cost-model self-check + the tier-1
-# tests/tune subset.  Run from anywhere; exits non-zero on ANY finding.
-# Future PRs run this before committing -- style/comm/explain are the
-# cheap static slice (no device execution); the tune tests execute small
-# factorizations on the virtual-CPU mesh (~a minute warm); the full test
-# suite stays `python -m pytest tests/ -m 'not slow'`.
+# tests/tune subset + the observability smoke (perf.trace run on a tiny
+# 1x1 problem) + the bench-trajectory regression gate (bench_diff).  Run
+# from anywhere; exits non-zero on ANY finding.  Future PRs run this
+# before committing -- style/comm/explain are the cheap static slice (no
+# device execution); the tune/obs tests execute small factorizations on
+# the virtual-CPU mesh (~a minute warm); the full test suite stays
+# `python -m pytest tests/ -m 'not slow'`.
 #
 #   tools/check.sh          # everything
 #   tools/check.sh style    # ruff (or the stdlib fallback) only
 #   tools/check.sh comm     # comm-plan lint + golden diff only
 #   tools/check.sh tune     # cost-model self-check + tests/tune only
+#   tools/check.sh obs      # perf.trace smoke + bench_diff gate + tests/obs
 set -u
 cd "$(dirname "$0")/.."
 
@@ -42,6 +45,23 @@ if [ "$what" = "all" ] || [ "$what" = "tune" ]; then
     python -m perf.tune explain cholesky || rc=1
     echo "== tune tier-1 tests =="
     python -m pytest tests/tune -q -m 'not slow' -p no:cacheprovider || rc=1
+fi
+
+if [ "$what" = "all" ] || [ "$what" = "obs" ]; then
+    echo "== perf.trace smoke (tiny n, 1x1 grid, CPU-safe) =="
+    JAX_PLATFORMS=cpu python -m perf.trace run cholesky --n 64 --nb 16 \
+        --grid 1x1 --out /tmp/el_trace_smoke.json >/dev/null || rc=1
+    echo "== bench-trajectory regression gate =="
+    # newest recorded bench vs the best of the earlier rounds (10% default
+    # threshold on the roofline-normalized ratios)
+    latest=$(ls BENCH_r*.json 2>/dev/null | sort | tail -1)
+    if [ -n "$latest" ]; then
+        python tools/bench_diff.py --check "$latest" || rc=1
+    else
+        echo "no BENCH_r*.json trajectory; skipping"
+    fi
+    echo "== obs tier-1 tests =="
+    python -m pytest tests/obs -q -m 'not slow' -p no:cacheprovider || rc=1
 fi
 
 if [ "$rc" -eq 0 ]; then
