@@ -191,20 +191,28 @@ def main():
                           "cholesky_value": round(chol_tflops, 3)}))
         return 1
 
-    # Tuner self-description (ISSUE 4): record the config the autotuner
+    # Tuner self-description (ISSUE 4 + 6): record the config the autotuner
     # resolves for each headline op -- and whether it came from a measured
     # cache entry or the analytic cost model -- so this BENCH line says
     # not just how fast, but under WHICH knobs a tuned run would execute.
-    # (The timed runs above use the pinned nb for baseline comparability.)
+    # Since ISSUE 6 the LU resolution includes the panel strategy
+    # ('classic' | 'calu'): on this single-chip grid 'auto' resolves to
+    # 'classic' (calu degenerates on single-row grids), and a multi-row
+    # bench would record 'calu' here -- the provenance the trajectory
+    # gate reads next to the renamed lu_n32768 metric.  (The timed runs
+    # above use the pinned nb/panel for baseline comparability.)
     tuner: dict = {"ran_with": {"nb": nb, "lookahead": True,
-                                "crossover": None}}
+                                "crossover": None, "panel": "classic"}}
     try:
         from elemental_tpu import tune as el_tune
         for op, nn in (("cholesky", n_chol), ("lu", n_lu)):
+            requested = {"nb": "auto", "lookahead": "auto",
+                         "crossover": "auto"}
+            if op == "lu":
+                requested["panel"] = "auto"
             res = el_tune.resolve(
                 op, gshape=(nn, nn), dtype=jnp.float32, grid=grid,
-                requested={"nb": "auto", "lookahead": "auto",
-                           "crossover": "auto"})
+                requested=requested)
             tuner[op] = {"config": dict(res.config), "source": res.source}
         tuner["cache_dir"] = el_tune.cache_dir()
     except Exception as e:                     # never fail the benchmark

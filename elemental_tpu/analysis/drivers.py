@@ -117,26 +117,27 @@ def _cholesky_spec(variant, lookahead, crossover):
     return DriverSpec(f"cholesky_{variant}", build)
 
 
-def _lu_spec(variant, lookahead, crossover):
+def _lu_spec(variant, lookahead, crossover, panel="classic"):
     def build(grid, n, nb, dtype):
         from ..lapack.lu import lu
 
         def fn(a):
             return lu(_as_dm(a, grid, n, n), nb=nb,
-                      lookahead=lookahead, crossover=crossover)
-        meta = {"lookahead": lookahead, "crossover": crossover}
+                      lookahead=lookahead, crossover=crossover, panel=panel)
+        meta = {"lookahead": lookahead, "crossover": crossover,
+                "panel": panel}
         return fn, (_mcmr_input(grid, n, n, dtype),), meta
     return DriverSpec(f"lu_{variant}", build)
 
 
-def _qr_spec():
+def _qr_spec(variant="", panel="classic"):
     def build(grid, n, nb, dtype):
         from ..lapack.qr import qr
 
         def fn(a):
-            return qr(_as_dm(a, grid, n, n), nb=nb)
-        return fn, (_mcmr_input(grid, n, n, dtype),), {}
-    return DriverSpec("qr", build)
+            return qr(_as_dm(a, grid, n, n), nb=nb, panel=panel)
+        return fn, (_mcmr_input(grid, n, n, dtype),), {"panel": panel}
+    return DriverSpec(f"qr_{variant}" if variant else "qr", build)
 
 
 def _registry() -> dict:
@@ -153,7 +154,15 @@ def _registry() -> dict:
         _lu_spec("classic", lookahead=False, crossover=0),
         _lu_spec("lookahead", lookahead=True, crossover=0),
         _lu_spec("crossover", lookahead=True, crossover=DEFAULT_XOVER),
+        # calu = ISSUE 6's tournament-pivoted panel on the default
+        # pipelined (lookahead + crossover-tail) schedule; the one-psum
+        # row-block solve replaces the classic all_to_all + all_gather
+        # pair, so its plan must stay strictly smaller than both
+        # lu_classic AND lu_crossover (pinned via CALU_PAIRS)
+        _lu_spec("calu", lookahead=True, crossover=DEFAULT_XOVER,
+                 panel="calu"),
         _qr_spec(),
+        _qr_spec("tsqr", panel="tsqr"),
     ]
     return {s.name: s for s in specs}
 
@@ -167,6 +176,15 @@ DRIVERS = _registry()
 LOOKAHEAD_PAIRS = (
     ("cholesky_crossover", "cholesky_classic"),
     ("lu_crossover", "lu_classic"),
+)
+
+#: CALU pins (ISSUE 6): at equal n/nb (equal panel count) the tournament-
+#: pivoted schedule must issue strictly fewer collective rounds than the
+#: classic partial-pivot baseline AND than the pipelined classic-panel
+#: default -- i.e. strictly fewer rounds PER PANEL.  (calu variant,
+#: classic-panel comparison variants.)
+CALU_PAIRS = (
+    ("lu_calu", ("lu_classic", "lu_crossover")),
 )
 
 

@@ -14,6 +14,14 @@ pivot search costs zero communication.  The panel's composed row
 permutation is applied to the trailing rows with one traced gather/scatter
 on the storage array (the analog of HPL's row-broadcast swap).
 
+Communication-avoiding panel (``panel='calu'``, ISSUE 6): tournament
+pivoting replaces even the replicated per-column pivot chain -- per-grid-
+row slab LUs, a log-depth playoff of candidate pivot blocks, ONE batched
+storage-level row permutation per panel, an unpivoted MXU-friendly
+refactorization, and a one-psum row-block solve.  See :func:`lu` and the
+README's "Communication-avoiding LU" section; ``panel='classic'``
+(default) is byte-for-byte the schedule described above.
+
 Look-ahead schedule (the HPL pipeline; default on)
 --------------------------------------------------
 The classic right-looking driver serializes panel -> swap -> solve ->
@@ -63,14 +71,17 @@ getrf (perm[i] = original index of the row now at position i).
 from __future__ import annotations
 
 import math
+from functools import partial
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..core.compat import shard_map
 from ..core.dist import MC, MR, STAR, VC, VR
 from ..core.distmatrix import DistMatrix
 from ..core.view import view, update_view
-from ..redist.engine import redistribute
+from ..redist.engine import move_rows, permute_rows_storage, redistribute
 from ..blas.level3 import _blocksize, _check_mcmr, local_rank_update, trsm
 
 #: chunk-width ladder for the replicated panel factorization.  A/B-measured
@@ -106,9 +117,14 @@ from ..obs.tracer import NULL_HOOK as _NULL_TIMER, phase_hook as _phase_hook
 def permute_rows(B: DistMatrix, perm, inverse: bool = False) -> DistMatrix:
     """B[perm, :] as a DistMatrix (``DistPermutation::PermuteRows``).
 
-    Rides [STAR,VR]: rows replicated there, so the traced-index gather is
-    pure-local; two engine hops re-land [MC,MR]."""
+    Zero-aligned [MC,MR] rides the engine's one-shot storage gather
+    (``permute_rows_storage``, the batched-permutation fast path -- no
+    explicit collective rounds); misaligned inputs keep the historical
+    [STAR,VR] route: rows replicated there, so the traced-index gather is
+    pure-local, and two engine hops re-land [MC,MR]."""
     _check_mcmr(B)
+    if (B.calign, B.ralign) == (0, 0):
+        return permute_rows_storage(B, perm, inverse=inverse)
     Bvr = redistribute(B, STAR, VR)
     p = jnp.argsort(perm) if inverse else perm
     out = Bvr.with_local(Bvr.local[p, :])
@@ -127,27 +143,13 @@ def permute_cols(B: DistMatrix, perm, inverse: bool = False) -> DistMatrix:
     return redistribute(out, MC, MR)
 
 
-def _storage_row(i, r: int, lr: int):
-    """Storage row of global row i for a stride-r zero-aligned dim."""
-    if r == 1:
-        return i
-    return (i % r) * lr + i // r
-
-
 def _apply_swaps_moved(A: DistMatrix, T, S, valid) -> DistMatrix:
-    """Move global rows ``S`` to positions ``T`` on the storage array,
+    """Move global rows ``S`` to positions ``T`` in one batched pass,
     dropping entries where ``valid`` is False (sentinel padding from
-    :func:`_moved_rows`).  The storage row map is a bijection between
-    slots and virtual indices, so invalid slots are forced out of range
-    rather than trusting the sentinel's arithmetic image."""
-    r, lr = A.col_stride, A.local_rows
-    m = A.gshape[0]
-    sidx = _storage_row(jnp.clip(T, 0, m - 1), r, lr)
-    sidx = jnp.where(valid, sidx, r * lr)          # OOB => scatter drops
-    gsrc = _storage_row(jnp.clip(S, 0, m - 1), r, lr)
-    stor = A.local
-    rows = jnp.take(stor, gsrc, axis=0)
-    return A.with_local(stor.at[sidx].set(rows, mode="drop"))
+    :func:`_moved_rows`).  Thin wrapper over the engine's storage-level
+    batched-permutation fast path (``redist.engine.move_rows``), kept
+    under its historical name for this module's importers."""
+    return move_rows(A, T, S, valid)
 
 
 # ---------------------------------------------------------------------
@@ -220,6 +222,191 @@ def _panel_lu(P, nbw: int, precision=None, inners=None):
     return P, perm
 
 
+# ---------------------------------------------------------------------
+# CALU tournament-pivoted panel (communication-avoiding LU, cf.
+# Grigori/Demmel/Xiang and the TPU distributed-linear-algebra paper
+# arXiv 2112.09017): each grid row factors its cyclic slab of the panel
+# with ordinary partial pivoting, the per-slab candidate pivot blocks
+# reduce in a log-depth pairwise-LU playoff tree, and the winning rows
+# are applied as ONE composed row permutation per panel.  The permuted
+# panel then factors WITHOUT pivoting: an nb x nb unpivoted diagonal
+# factorization plus a single MXU matmul for the whole L21 block --
+# no per-column argmax or data-dependent row swap over the panel height,
+# which is exactly the latency wall of the classic panel.
+# ---------------------------------------------------------------------
+
+def _playoff_perm(V, ncol: int):
+    """Pivot ORDER of a masked partial-pivot LU sweep over a (possibly
+    zero-padded) block: returns the composed permutation only (the factor
+    values are discarded -- playoffs select rows, the real factorization
+    happens once on the winners).  Divisions are guarded so all-zero
+    padding rows flow through as zeros instead of NaNs."""
+    Mp, w = V.shape
+    ridx = jnp.arange(Mp)
+    cidx = jnp.arange(w)
+
+    def body(j, state):
+        V, perm = state
+        cand = jnp.where(ridx >= j, jnp.abs(V[:, j]), -jnp.inf)
+        p = jnp.argmax(cand)
+        rowj, rowp = V[j], V[p]
+        V = V.at[j].set(rowp).at[p].set(rowj)
+        pj, pp = perm[j], perm[p]
+        perm = perm.at[j].set(pp).at[p].set(pj)
+        piv = V[j, j]
+        safe = jnp.where(piv == 0, jnp.ones_like(piv), piv)
+        l = jnp.where(ridx > j, V[:, j] / safe, jnp.zeros_like(V[:, j]))
+        V = V.at[:, j].set(jnp.where(ridx > j, l, V[:, j]))
+        urow = jnp.where(cidx > j, V[j], jnp.zeros_like(V[j]))
+        return V - jnp.outer(l, urow), perm
+
+    _, perm = lax.fori_loop(0, min(ncol, Mp), body, (V, jnp.arange(Mp)))
+    return perm
+
+
+def _tournament_pivots(P, nbw: int, r: int):
+    """The CALU tournament: composed panel permutation (perm[i] = original
+    row now at position i) whose first ``nbw`` entries are the playoff
+    winners.  Runs replicated and deterministic on every device (same
+    zero-communication pattern as the classic replicated panel): slab
+    membership mirrors the [MC,*] ownership map (global row i lives in
+    grid row i % r), so the simulated tournament selects exactly the
+    pivots a message-passing CALU over the grid rows would."""
+    M = P.shape[0]
+    lslab = max(-(-M // r), nbw)
+    sidx = jnp.arange(lslab)[None, :] * r + jnp.arange(r)[:, None]
+    ok = sidx < M                                       # (r, lslab)
+    vals = jnp.where(ok[:, :, None], P[jnp.clip(sidx, 0, M - 1)], 0)
+    gidx = jnp.where(ok, sidx, M)                       # sentinel M = padding
+    # round 0: every slab's local partial-pivot sweep (vmapped -- the
+    # replicated image of r independent, communication-free local LUs)
+    sperm = jax.vmap(lambda v: _playoff_perm(v, nbw))(vals)
+    top = sperm[:, :nbw]
+    cvals = jnp.take_along_axis(vals, top[:, :, None], axis=1)
+    cidx = jnp.take_along_axis(gidx, top, axis=1)       # (r, nbw)
+    # log-depth pairwise playoffs (odd participant gets a bye)
+    nblk = r
+    while nblk > 1:
+        half, odd = nblk // 2, nblk % 2
+        lo_v, hi_v = cvals[:half], cvals[half:2 * half]
+        lo_i, hi_i = cidx[:half], cidx[half:2 * half]
+        st_v = jnp.concatenate([lo_v, hi_v], axis=1)    # (half, 2*nbw, nbw)
+        st_i = jnp.concatenate([lo_i, hi_i], axis=1)
+        pperm = jax.vmap(lambda v: _playoff_perm(v, nbw))(st_v)
+        wtop = pperm[:, :nbw]
+        wv = jnp.take_along_axis(st_v, wtop[:, :, None], axis=1)
+        wi = jnp.take_along_axis(st_i, wtop, axis=1)
+        if odd:
+            wv = jnp.concatenate([wv, cvals[2 * half:]], axis=0)
+            wi = jnp.concatenate([wi, cidx[2 * half:]], axis=0)
+        cvals, cidx = wv, wi
+        nblk = half + odd
+    win = cidx[0]                                       # (nbw,) global rows
+    # compose the one-shot permutation: winner j swaps into position j
+    # (a padding sentinel degenerates to a no-op swap; only reachable on
+    # exactly-singular panels, where classic pivoting is arbitrary too)
+    def body(j, state):
+        perm, invp = state
+        w = jnp.where(win[j] < M, win[j], perm[j])
+        tp = invp[w]
+        pj = perm[j]
+        perm = perm.at[j].set(w).at[tp].set(pj)
+        invp = invp.at[w].set(j).at[pj].set(tp)
+        return perm, invp
+
+    perm, _ = lax.fori_loop(0, nbw, body, (jnp.arange(M), jnp.arange(M)))
+    return perm
+
+
+def _lu_nopiv(W, precision=None, bs: int = 256):
+    """Unpivoted blocked LU of a square block (packed L\\U, unit-lower L).
+    The CALU diagonal factorization: the tournament already fixed the
+    pivot order, so no argmax / row motion remains -- diagonal blocks run
+    the plain recurrence, off-diagonal blocks are triangular solves and
+    one MXU matmul per step."""
+    b = W.shape[0]
+
+    def unb(B):
+        n = B.shape[0]
+        idx = jnp.arange(n)
+
+        def body(j, B):
+            l = jnp.where(idx > j, B[:, j] / B[j, j], jnp.zeros_like(B[:, j]))
+            B = B.at[:, j].set(jnp.where(idx > j, l, B[:, j]))
+            urow = jnp.where(idx > j, B[j], jnp.zeros_like(B[j]))
+            return B - jnp.outer(l, urow)
+
+        return lax.fori_loop(0, n, body, B)
+
+    if b <= bs:
+        return unb(W)
+    for s in range(0, b, bs):
+        e = min(s + bs, b)
+        blk = unb(W[s:e, s:e])
+        W = W.at[s:e, s:e].set(blk)
+        if e < b:
+            L11 = jnp.tril(blk, -1) + jnp.eye(e - s, dtype=W.dtype)
+            U12 = lax.linalg.triangular_solve(
+                L11, W[s:e, e:], left_side=True, lower=True,
+                unit_diagonal=True)
+            L21 = lax.linalg.triangular_solve(
+                jnp.triu(blk), W[e:, s:e], left_side=False, lower=False)
+            W = W.at[s:e, e:].set(U12).at[e:, s:e].set(L21)
+            upd = jnp.matmul(L21, U12, precision=_hi(precision))
+            W = W.at[e:, e:].set(W[e:, e:] - upd.astype(W.dtype))
+    return W
+
+
+def _upper_inv(U, nbw: int, precision=None, bs: int = 256):
+    """Inverse of a non-unit upper-triangular block with matmul assembly
+    (the upper sibling of :func:`_unit_lower_inv`) -- turns the CALU
+    ``L21 := A21 U11^{-1}`` panel solve into one MXU matmul."""
+    dt = U.dtype
+    if nbw <= bs:
+        return lax.linalg.triangular_solve(
+            U, jnp.eye(nbw, dtype=dt), left_side=True, lower=False)
+    Ui = jnp.zeros((nbw, nbw), dt)
+    for s in range(0, nbw, bs):
+        e = min(s + bs, nbw)
+        Uikk = lax.linalg.triangular_solve(
+            U[s:e, s:e], jnp.eye(e - s, dtype=dt), left_side=True,
+            lower=False)
+        if s > 0:
+            corr = jnp.matmul(
+                jnp.matmul(Ui[:s, :s], U[:s, s:e], precision=_hi(precision)),
+                Uikk, precision=_hi(precision))
+            Ui = Ui.at[:s, s:e].set(-corr.astype(dt))
+        Ui = Ui.at[s:e, s:e].set(Uikk)
+    return Ui
+
+
+def _nopiv_panel(Pp, nbw: int, precision=None):
+    """Unpivoted factorization of an already-permuted (M, nbw) panel:
+    packed ``[L11\\U11; L21]`` with ``L21 = A21 U11^{-1}`` as one matmul.
+    Shared by the CALU panel (winners on top) and the TSQR Householder
+    reconstruction in ``qr.py`` (LU of ``Q1 - S``)."""
+    Wf = _lu_nopiv(Pp[:nbw], precision)
+    Ui = _upper_inv(jnp.triu(Wf), nbw, precision)
+    L21 = jnp.matmul(Pp[nbw:], Ui, precision=_hi(precision)).astype(Pp.dtype)
+    return jnp.concatenate([Wf, L21], axis=0)
+
+
+def _calu_panel(P, nbw: int, r: int, precision=None):
+    """CALU panel factorization of a replicated (M, nbw) panel: tournament
+    pivot selection over ``r`` grid-row slabs + unpivoted refactorization
+    of the permuted panel.  Same ``(packed, perm)`` contract as
+    :func:`_panel_lu`, so the look-ahead / crossover machinery consumes it
+    unchanged.  With ``r == 1`` the tournament IS partial pivoting (one
+    slab, winners = the PP pivots), so the classic panel is called
+    directly -- bit-identical pivots on single-row grids."""
+    M = P.shape[0]
+    if r <= 1 or M <= nbw:
+        return _panel_lu(P, nbw, precision)
+    perm = _tournament_pivots(P, nbw, r)
+    Pp = jnp.take(P, perm, axis=0)
+    return _nopiv_panel(Pp, nbw, precision), perm
+
+
 def _unit_lower_inv(L11, nbw: int, precision=None, bs: int = 256):
     """Inverse of a unit-lower (nbw, nbw) panel block with matmul assembly
     (small triangular_solve only at ``bs`` diagonal blocks) -- turns the
@@ -257,6 +444,46 @@ def _moved_rows(pperm, nbw: int):
     idx = jnp.nonzero(moved, size=k, fill_value=M)[0]
     src = pperm[jnp.clip(idx, 0, M - 1)]
     return idx, src
+
+
+# ---------------------------------------------------------------------
+# one-collective row-block solve (the CALU schedule's U12 path)
+# ---------------------------------------------------------------------
+
+@partial(jax.jit, static_argnums=(2,))
+def _rowblock_solve_jit(Ablk: DistMatrix, Li11, precision):
+    """``U = Li11 @ Ablk`` for an (nbw, w) [MC,MR] row block, landing
+    [STAR,MR] in ONE psum round.
+
+    The classic schedule moves the row block to [STAR,VR] (an all_to_all),
+    multiplies locally, and promotes VR -> MR (an all_gather): two
+    collective rounds per panel.  Here each device contracts the
+    replicated ``Li11`` against only the block rows it already stores
+    (columns ``mc + r*iLoc`` of ``Li11``) and one ``psum`` over the grid
+    column completes the product -- the contraction is genuinely
+    distributed over grid rows, r-fold less panel-solve compute per
+    device AND one round instead of two."""
+    g = Ablk.grid
+    r = g.height
+    nbw = Ablk.gshape[0]
+    out_meta = DistMatrix(None, Ablk.gshape, STAR, MR, 0, 0, g)
+
+    def f(ab, L):
+        mc = lax.axis_index("mc")
+        lr = ab.local.shape[0]
+        cols = mc + r * jnp.arange(lr)
+        okc = cols < nbw
+        Lsub = jnp.take(L, jnp.clip(cols, 0, nbw - 1), axis=1)
+        Lsub = jnp.where(okc[None, :], Lsub, 0)
+        part = jnp.matmul(Lsub, ab.local, precision=precision)
+        out = lax.psum(part, "mc")
+        return DistMatrix(out, ab.gshape, STAR, MR, 0, ab.ralign, g)
+
+    from jax.sharding import PartitionSpec as P
+    return shard_map(
+        f, mesh=g.mesh, in_specs=(Ablk.spec, P(None, None)),
+        out_specs=out_meta.spec, check_vma=False,
+    )(Ablk, Li11)
 
 
 # ---------------------------------------------------------------------
@@ -354,7 +581,8 @@ _CROSSOVER = 4096
 
 def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
        update_precision=None, lookahead: bool | str = True,
-       crossover: int | str | None = None, timer=None):
+       crossover: int | str | None = None, panel: str = "classic",
+       timer=None):
     """Blocked right-looking LU with partial pivoting and look-ahead.
 
     Returns (LU, perm): LU holds unit-lower L below the diagonal and U on
@@ -374,23 +602,60 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
     documented ~1e-3 residual cost); ``timer`` enables eager per-phase
     wall-clock attribution (see ``perf/phase_timer.py``).
 
-    ``nb`` / ``lookahead`` / ``crossover`` accept ``'auto'``: the tuning
-    subsystem (``elemental_tpu/tune``) resolves them per (shape, dtype,
-    grid, backend) -- measured-cache winner first, analytic cost model
-    cold; explicit values always win."""
+    ``panel`` selects the panel strategy:
+
+      * ``'classic'`` (default) -- replicated partial-pivot panel, the
+        bit-exactness A/B + stability baseline.
+      * ``'calu'`` -- communication-avoiding tournament pivoting
+        (:func:`_calu_panel`): per-grid-row slab LUs, a log-depth playoff
+        of candidate pivot blocks, one batched row permutation per panel,
+        an unpivoted MXU-friendly panel refactorization, and a
+        one-``psum`` row-block solve (:func:`_rowblock_solve_jit`) in
+        place of the classic two-round [STAR,VR] dance.  Pivots differ
+        from partial pivoting (growth factor bounded by the tournament,
+        not by 2^k -- see README "Communication-avoiding LU"); on
+        single-row grids (r == 1, incl. 1x1) calu degenerates to classic
+        exactly.  The crossover tail finishes with the local classic
+        kernel under either strategy.
+
+    ``nb`` / ``lookahead`` / ``crossover`` / ``panel`` accept ``'auto'``:
+    the tuning subsystem (``elemental_tpu/tune``) resolves them per
+    (shape, dtype, grid, backend) -- measured-cache winner first, analytic
+    cost model cold; explicit values always win.  ``panel='auto'`` picks
+    calu on multi-row grids and classic on single-row ones (the pivot
+    latency term of the cost model)."""
     _check_mcmr(A)
-    if any(isinstance(v, str) for v in (nb, lookahead, crossover)):
+    if any(isinstance(v, str) for v in (nb, lookahead, crossover)) \
+            or panel == "auto":
         from ..tune.policy import resolve_knobs
         kn = resolve_knobs("lu", gshape=A.gshape, dtype=A.dtype, grid=A.grid,
                            knobs={"nb": nb, "lookahead": lookahead,
-                                  "crossover": crossover})
+                                  "crossover": crossover, "panel": panel})
         nb, lookahead, crossover = kn["nb"], kn["lookahead"], kn["crossover"]
+        panel = kn["panel"]
+    if panel is None:
+        panel = "classic"
+    if panel not in ("classic", "calu"):
+        raise ValueError(f"lu: unknown panel strategy {panel!r}; "
+                         "expected 'classic', 'calu', or 'auto'")
     m, n = A.gshape
     g = A.grid
     tm = _phase_hook("lu", timer)
     if g.size == 1:
         return _local_lu(A, nb, precision, update_precision, lookahead, tm)
     r, c = g.height, g.width
+    calu = panel == "calu" and r > 1
+
+    def factor_panel(Ploc, w: int, step: int):
+        """One panel under the selected strategy; ticks the tournament
+        phase (obs) between pivot selection and the unpivoted refactor."""
+        if not calu or Ploc.shape[0] <= w:
+            return _panel_lu(Ploc, w, precision)
+        pperm = _tournament_pivots(Ploc, w, r)
+        tm.tick("tournament", step, pperm)
+        Pp = jnp.take(Ploc, pperm, axis=0)
+        return _nopiv_panel(Pp, w, precision), pperm
+
     ib = _blocksize(nb, math.lcm(r, c), min(m, n))
     kend = min(m, n)
     perm = jnp.arange(m)
@@ -409,8 +674,7 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
         e0_up = col_up(min(ib, kend))
         panel0 = redistribute(view(A, rows=(0, m), cols=(0, e0_up)),
                               STAR, STAR)
-        nxt = _panel_lu(panel0.local[:, :min(ib, kend)], min(ib, kend),
-                        precision)
+        nxt = factor_panel(panel0.local[:, :min(ib, kend)], min(ib, kend), 0)
         tm.tick("panel", 0, nxt)
     for k, s in enumerate(range(0, kend, ib)):
         e = min(s + ib, kend)
@@ -426,7 +690,7 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
         else:
             panel = redistribute(view(A, rows=(s, m), cols=(s, e_up)),
                                  STAR, STAR)
-            Pf, pperm = _panel_lu(panel.local[:, :nbw], nbw, precision)
+            Pf, pperm = factor_panel(panel.local[:, :nbw], nbw, k)
             tm.tick("panel", k, Pf, pperm)
         perm = perm.at[s:].set(jnp.take(perm[s:], pperm, axis=0))
         # move only the rows the panel permutation displaced (<= 2*nbw)
@@ -450,11 +714,19 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
         Li11 = _unit_lower_inv(jnp.tril(Pf[:nbw, :], -1)
                                + jnp.eye(nbw, dtype=Pf.dtype),
                                nbw, precision)
-        A1n = redistribute(view(A, rows=(s, e), cols=(s, n)), STAR, VR)
-        u1n = jnp.matmul(Li11, A1n.local, precision=_hi(precision)
-                         ).astype(Pf.dtype)
-        U1n = DistMatrix(u1n, (nbw, n - s), STAR, VR, 0, 0, g)
-        U1n_mr = redistribute(U1n, STAR, MR)
+        if calu:
+            # one-psum row-block solve: the contraction over the block's
+            # rows distributes across grid rows and a single psum lands
+            # [STAR,MR] -- one round instead of the classic all_to_all +
+            # all_gather pair below
+            U1n_mr = _rowblock_solve_jit(view(A, rows=(s, e), cols=(s, n)),
+                                         Li11, _hi(precision))
+        else:
+            A1n = redistribute(view(A, rows=(s, e), cols=(s, n)), STAR, VR)
+            u1n = jnp.matmul(Li11, A1n.local, precision=_hi(precision)
+                             ).astype(Pf.dtype)
+            U1n = DistMatrix(u1n, (nbw, n - s), STAR, VR, 0, 0, g)
+            U1n_mr = redistribute(U1n, STAR, MR)
         tm.tick("solve", k, U1n_mr)
         if not lookahead or e >= kend:
             A = _update_cols_ge(A, redistribute(U1n_mr, MC, MR), (s, e),
@@ -491,7 +763,7 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
             # already (m-e, e2_up-e) from the view metadata); skipped when
             # the tail finish below refactors the whole trailing block
             strip_ss = redistribute(stripD, STAR, STAR)
-            nxt = _panel_lu(strip_ss.local[:, :e2 - e], e2 - e, precision)
+            nxt = factor_panel(strip_ss.local[:, :e2 - e], e2 - e, k + 1)
             tm.tick("panel", k + 1, nxt)
         # (b) wide remainder update, cols >= e2_up
         if e2_up < n:
@@ -565,10 +837,13 @@ def _update_cols_ge(A, block, rows, cols, e):
 
 
 def lu_solve(A: DistMatrix, B: DistMatrix, nb: int | None = None,
-             precision=None) -> DistMatrix:
+             precision=None, panel: str = "classic") -> DistMatrix:
     """Solve A X = B via LU with partial pivoting (``El::LinearSolve``,
-    ``src/lapack_like/solve/LinearSolve.cpp``: LU + SolveAfter)."""
-    LU_, perm = lu(A, nb=nb, precision=precision)
+    ``src/lapack_like/solve/LinearSolve.cpp``: LU + SolveAfter).
+    ``panel`` selects the factorization's panel strategy (see :func:`lu`);
+    the solve-after path is strategy-agnostic -- it only consumes the
+    packed factor and the composed permutation."""
+    LU_, perm = lu(A, nb=nb, precision=precision, panel=panel)
     return lu_solve_after(LU_, perm, B, nb=nb, precision=precision)
 
 

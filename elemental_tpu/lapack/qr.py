@@ -32,7 +32,8 @@ from ..core.view import view, update_view
 from ..core.compat import shard_map
 from ..redist.engine import redistribute
 from ..blas.level3 import _blocksize, _check_mcmr, trsm
-from .lu import _update_cols_lt, _update_cols_ge, _hi, _phase_hook
+from .lu import (_update_cols_lt, _update_cols_ge, _hi, _phase_hook,
+                 _nopiv_panel)
 
 
 # ---------------------------------------------------------------------
@@ -105,11 +106,95 @@ def _panel_v(Pf):
 
 
 # ---------------------------------------------------------------------
+# TSQR/CAQR tree panel (the QR rider of the CALU PR): local Householder
+# QR per grid-row slab, a log-depth pairwise reduction of the R factors,
+# and the aggregated thin Q converted BACK to geqrf packing via the
+# LU-based Householder reconstruction (Ballard/Demmel et al., "Recon-
+# structing Householder vectors from TSQR"), so every downstream consumer
+# -- compact-WY trailing updates, apply_q, least_squares -- is unchanged.
+# ---------------------------------------------------------------------
+
+def _tsqr_tree(P, r: int, precision=None):
+    """Replicated TSQR reduction of an (M, b) panel over ``r`` cyclic
+    grid-row slabs: returns ``(Q1, R)`` with Q1 the explicit thin
+    orthonormal factor (rows back in original order) and R upper
+    triangular.  The tree mirrors a message-passing CAQR: slab QRs are
+    independent (zero communication), then ceil(log2(r)) pairwise
+    stacked-QR playoffs combine the R factors, with each leaf's b x b
+    aggregated transform accumulated so Q1 is assembled by one matmul
+    per slab."""
+    M, b = P.shape
+    lslab = max(-(-M // r), b)
+    sidx = jnp.arange(lslab)[None, :] * r + jnp.arange(r)[:, None]
+    ok = sidx < M                                       # (r, lslab)
+    vals = jnp.where(ok[:, :, None], P[jnp.clip(sidx, 0, M - 1)], 0)
+    with jax.default_matmul_precision("highest"):
+        Qs, Rs = jax.vmap(lambda v: jnp.linalg.qr(v, mode="reduced"))(vals)
+    Rlist = [Rs[i] for i in range(r)]
+    groups = [[i] for i in range(r)]
+    Ts = [None] * r                                     # None == identity
+    while len(Rlist) > 1:
+        nR, nG = [], []
+        for a in range(0, len(Rlist) - 1, 2):
+            st = jnp.concatenate([Rlist[a], Rlist[a + 1]], axis=0)
+            with jax.default_matmul_precision("highest"):
+                q, rnew = jnp.linalg.qr(st, mode="reduced")
+            for leaf, blk in ((groups[a], q[:b]), (groups[a + 1], q[b:])):
+                for i in leaf:
+                    Ts[i] = blk if Ts[i] is None else jnp.matmul(
+                        Ts[i], blk, precision=_hi(precision))
+            nR.append(rnew)
+            nG.append(groups[a] + groups[a + 1])
+        if len(Rlist) % 2:
+            nR.append(Rlist[-1])
+            nG.append(groups[-1])
+        Rlist, groups = nR, nG
+    T = jnp.stack([jnp.eye(b, dtype=P.dtype) if t is None else t
+                   for t in Ts])
+    Qfull = jnp.matmul(Qs, T, precision=_hi(precision))  # (r, lslab, b)
+    targets = jnp.where(ok, sidx, M).reshape(-1)
+    Q1 = jnp.zeros((M, b), P.dtype).at[targets].set(
+        Qfull.reshape(r * lslab, b), mode="drop")
+    return Q1, Rlist[0]
+
+
+def _panel_qr_tsqr(P, r: int, precision=None):
+    """TSQR tree panel in geqrf packing: ``(packed V\\R, tau)``, same
+    contract as :func:`_panel_qr`.
+
+    The tree (:func:`_tsqr_tree`) produces the explicit thin ``Q1`` and
+    ``R``; the Householder form is reconstructed exactly from the
+    identity ``Q1 - [I; 0] = Y U`` (Y the unit-lower-trapezoidal
+    reflector panel, ``U = -T Y1^H`` upper triangular), i.e. ONE
+    unpivoted LU of ``Q1 - I`` -- the lu module's :func:`_nopiv_panel` --
+    with ``tau_j = -U[j,j]``.  Columns are sign-flipped first so the
+    diagonal of ``Q1 - I`` is bounded away from zero (the stability
+    device of the reconstruction paper).  Replaces the serial
+    column-at-a-time larfg recurrence over the full panel height with
+    slab-local QR kernels plus log-depth b x b reductions."""
+    M, b = P.shape
+    Q1, R = _tsqr_tree(P, max(int(r), 1), precision)
+    d = jnp.diagonal(Q1[:b])
+    absd = jnp.abs(d)
+    s = jnp.where(absd == 0, -jnp.ones_like(d),
+                  -(jnp.conj(d) / jnp.where(absd == 0, 1, absd)))
+    s = s.astype(P.dtype)
+    Q1p = Q1 * s[None, :]
+    Rp = jnp.conj(s)[:, None] * R
+    B = Q1p.at[:b].add(-jnp.eye(b, dtype=P.dtype))
+    F = _nopiv_panel(B, b, precision)
+    tau = -jnp.diagonal(F[:b])
+    packed = jnp.concatenate(
+        [jnp.triu(Rp) + jnp.tril(F[:b], -1), F[b:]], axis=0)
+    return packed, tau
+
+
+# ---------------------------------------------------------------------
 # blocked Householder QR
 # ---------------------------------------------------------------------
 
 def qr(A: DistMatrix, nb: int | str | None = None, precision=None,
-       timer=None):
+       panel: str = "classic", timer=None):
     """Blocked Householder QR; returns (packed, tau) in geqrf format.
 
     ``nb='auto'`` asks the tuning subsystem for the panel width.  The
@@ -121,14 +206,28 @@ def qr(A: DistMatrix, nb: int | str | None = None, precision=None,
     boundary -- inside jit, pass the same ``nb`` to both ends as before.)
     ``timer`` enables eager per-phase (panel/update) wall-clock
     attribution, same protocol as ``lu``/``cholesky`` (ISSUE 5).
-    """
+
+    ``panel`` selects the panel reduction: ``'classic'`` (default) is the
+    replicated column-at-a-time larfg recurrence; ``'tsqr'`` the TSQR/CAQR
+    tree panel (:func:`_panel_qr_tsqr`) -- slab-local QR kernels per grid
+    row, a log-depth R reduction, and LU-based Householder reconstruction
+    back into the SAME geqrf packing, so ``apply_q``/``least_squares``
+    consume the result unchanged (R's diagonal signs may differ from
+    classic; the (packed, tau) pair is self-consistent).  ``'auto'``
+    resolves through the tuning subsystem like ``nb``."""
     _check_mcmr(A)
     m, n = A.gshape
     g = A.grid
-    if isinstance(nb, str):
+    if isinstance(nb, str) or panel == "auto":
         from ..tune.policy import resolve_knobs
-        nb = resolve_knobs("qr", gshape=A.gshape, dtype=A.dtype, grid=g,
-                           knobs={"nb": nb})["nb"]
+        kn = resolve_knobs("qr", gshape=A.gshape, dtype=A.dtype, grid=g,
+                           knobs={"nb": nb, "panel": panel})
+        nb, panel = kn["nb"], kn["panel"]
+    if panel is None:
+        panel = "classic"
+    if panel not in ("classic", "tsqr"):
+        raise ValueError(f"qr: unknown panel strategy {panel!r}; "
+                         "expected 'classic', 'tsqr', or 'auto'")
     tm = _phase_hook("qr", timer)
     tm.start()
     r, c = g.height, g.width
@@ -139,8 +238,12 @@ def qr(A: DistMatrix, nb: int | str | None = None, precision=None,
         e = min(s + ib, kend)
         nbw = e - s
         e_up = min(-(-e // c) * c, n)
-        panel = redistribute(view(A, rows=(s, m), cols=(s, e_up)), STAR, STAR)
-        Pf, tau = _panel_qr(panel.local[:, :nbw])
+        panel_ss = redistribute(view(A, rows=(s, m), cols=(s, e_up)),
+                                STAR, STAR)
+        if panel == "tsqr":
+            Pf, tau = _panel_qr_tsqr(panel_ss.local[:, :nbw], r, precision)
+        else:
+            Pf, tau = _panel_qr(panel_ss.local[:, :nbw])
         taus.append(tau)
         tm.tick("panel", k, Pf, tau)
         if e_up > e:

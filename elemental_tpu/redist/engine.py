@@ -718,6 +718,70 @@ def panel_spread(A: DistMatrix, conj: bool = True):
 
 
 # ---------------------------------------------------------------------
+# batched storage-level row permutations (the COSTA-style one-shot plan)
+# ---------------------------------------------------------------------
+
+def _storage_row_of(i, S: int, lr: int):
+    """Storage row of global row i for a stride-S zero-aligned column dim
+    (stacked-storage layout: slot-major, then local offset)."""
+    if S == 1:
+        return i
+    return (i % S) * lr + i // S
+
+
+def move_rows(A: DistMatrix, targets, sources, valid) -> DistMatrix:
+    """Move global rows ``sources`` to positions ``targets`` in ONE
+    storage-level gather/scatter pass, dropping entries where ``valid`` is
+    False (sentinel padding).
+
+    The batched-permutation fast path of the engine (COSTA direction,
+    PAPERS.md 2106.06601): a panel's composed pivot permutation -- nb
+    tournament winners plus the <= nb rows they displace, or partial
+    pivoting's <= 2 nb moved rows -- is applied as a single collective
+    plan on the stacked storage instead of a per-row swap chain.  The
+    storage row map is a bijection between slots and virtual indices, so
+    invalid slots are forced out of range rather than trusting the
+    sentinel's arithmetic image.  No named collective is issued: the
+    cross-device row motion lowers through GSPMD's partitioner, so the
+    comm-plan analyzer sees the swap phase as zero explicit rounds
+    (``REDIST_COUNTS['row_permute']`` still counts the entry calls)."""
+    REDIST_COUNTS["row_permute"] += 1
+    S, lr = A.col_stride, A.local_rows
+    m = A.gshape[0]
+    sidx = _storage_row_of(jnp.clip(targets, 0, m - 1), S, lr)
+    sidx = jnp.where(valid, sidx, S * lr)          # OOB => scatter drops
+    gsrc = _storage_row_of(jnp.clip(sources, 0, m - 1), S, lr)
+    stor = A.local
+    rows = jnp.take(stor, gsrc, axis=0)
+    return A.with_local(stor.at[sidx].set(rows, mode="drop"))
+
+
+def permute_rows_storage(A: DistMatrix, perm, inverse: bool = False
+                         ) -> DistMatrix:
+    """``B[i] = A[perm[i]]`` as ONE storage-level gather for a zero-aligned
+    row-cyclic matrix (full-permutation sibling of :func:`move_rows`).
+
+    Replaces the historical [STAR,VR] round trip (two collective rounds:
+    demote + promote) with a single storage gather whose cross-device
+    motion GSPMD plans directly -- the engine-level fast path behind
+    ``lapack.lu.permute_rows``."""
+    if (A.calign, A.ralign) != (0, 0):
+        raise ValueError(f"permute_rows_storage needs zero alignments, got {A}")
+    REDIST_COUNTS["row_permute"] += 1
+    p = jnp.argsort(perm) if inverse else perm
+    m = A.gshape[0]
+    S, lr = A.col_stride, A.local_rows
+    if S == 1:
+        return A.with_local(jnp.take(A.local, p, axis=0))
+    sr = jnp.arange(S * lr)
+    gi = (sr % lr) * S + sr // lr                  # global row of storage slot
+    src = _storage_row_of(p[jnp.clip(gi, 0, m - 1)], S, lr)
+    out = jnp.take(A.local, src, axis=0)
+    out = jnp.where((gi < m)[:, None], out, 0)     # keep padding zeroed
+    return A.with_local(out)
+
+
+# ---------------------------------------------------------------------
 # transpose-dist ([U,V] -> [V,U] with local transpose; free)
 # ---------------------------------------------------------------------
 

@@ -87,15 +87,18 @@ class CostBreakdown:
     comm_bytes: float          # extrapolated ring-model bytes per device
     prim_counts: dict          # per-collective counts AT TRACE GEOMETRY
     detail: dict               # trace geometry / closed-form site notes
+    pivot_s: float = 0.0       # pivot/reflector serial-chain latency
 
     @property
     def total_s(self) -> float:
-        return self.compute_s + self.latency_s + self.bandwidth_s
+        return self.compute_s + self.latency_s + self.bandwidth_s \
+            + self.pivot_s
 
     def to_doc(self) -> dict:
         return {"config": dict(self.config),
                 "total_s": self.total_s, "compute_s": self.compute_s,
                 "latency_s": self.latency_s, "bandwidth_s": self.bandwidth_s,
+                "pivot_s": self.pivot_s,
                 "rounds": self.rounds, "comm_bytes": self.comm_bytes,
                 "prim_counts": dict(self.prim_counts),
                 "detail": dict(self.detail)}
@@ -138,6 +141,33 @@ def _compute_seconds(op: str, ctx: TuneContext, nb, machine: MachineModel,
     ext = max(ctx.extent, 1)
     nb_r = blocksize_policy(nb, ctx.grain, ext)
     return base * (1.0 + HALF_NB / nb_r + IMB * nb_r / ext)
+
+
+def _pivot_seconds(op: str, ctx: TuneContext, config: dict,
+                   machine: MachineModel) -> float:
+    """Pivot/reflector serial-chain latency: the term that differentiates
+    the panel strategies (ISSUE 6).
+
+    The classic panels of lu/qr run one data-dependent step PER COLUMN
+    over the full panel height -- an ``extent``-deep serial chain the MXU
+    roofline term cannot see.  The tree panels (CALU tournament / TSQR)
+    split that chain across the ``r`` grid rows (depth ``extent / r``)
+    and add ``ceil(log2 r)`` pairwise playoff/reduction rounds per panel.
+    Each unit of chain depth is priced at one ``machine.latency_s`` -- a
+    RANKING device like the rest of the model: on single-row grids both
+    strategies price identically (the slab IS the panel) and the
+    candidate order's classic-first tie-break keeps the baseline."""
+    if op not in ("lu", "qr"):
+        return 0.0
+    ext = max(ctx.extent, 1)
+    unit = machine.latency_s
+    panel = config.get("panel") or "classic"
+    r = ctx.grid_shape[0]
+    if panel == "classic" or r <= 1:
+        return ext * unit
+    nb_r = blocksize_policy(config.get("nb"), ctx.grain, ext)
+    steps = max(1, math.ceil(ext / nb_r))
+    return (ext / r) * unit + steps * math.ceil(math.log2(r)) * unit
 
 
 # ---------------------------------------------------------------------
@@ -191,10 +221,11 @@ def _geometry(ctx: TuneContext, nb, crossover, lookahead):
     return dims_t, nb_t, xo_t, lat_scale, area
 
 
-def _trace_stats(op: str, dims_t, nb_t: int, la, xo_t, grid, dtype):
+def _trace_stats(op: str, dims_t, nb_t: int, la, xo_t, grid, dtype,
+                 panel: str = "classic"):
     """Abstract-trace ``op`` at the scaled geometry; totals memoized."""
     key = (op, dims_t, nb_t, bool(la), int(xo_t),
-           (grid.height, grid.width), str(dtype))
+           (grid.height, grid.width), str(dtype), panel)
     hit = _TRACE_MEMO.get(key)
     if hit is not None:
         return hit
@@ -224,14 +255,15 @@ def _trace_stats(op: str, dims_t, nb_t: int, la, xo_t, grid, dtype):
 
         def fn(a):
             from ..lapack.lu import lu
-            return lu(dm(a, m, n), nb=nb_t, lookahead=la, crossover=xo_t)
+            return lu(dm(a, m, n), nb=nb_t, lookahead=la, crossover=xo_t,
+                      panel=panel)
         args = (inp(m, n),)
     elif op == "qr":
         m, n = dims_t[0], dims_t[-1]
 
         def fn(a):
             from ..lapack.qr import qr
-            return qr(dm(a, m, n), nb=nb_t)
+            return qr(dm(a, m, n), nb=nb_t, panel=panel)
         args = (inp(m, n),)
     elif op == "trsm":
         m, n = dims_t[0], dims_t[-1]
@@ -269,8 +301,9 @@ def _traced_cost(op: str, config: dict, ctx: TuneContext, grid, dtype,
     la = config.get("lookahead", True)
     xo = config.get("crossover")
     nb = config.get("nb")
+    panel = config.get("panel") or "classic"
     dims_t, nb_t, xo_t, lat_scale, byte_scale = _geometry(ctx, nb, xo, la)
-    stats = _trace_stats(op, dims_t, nb_t, la, xo_t, grid, dtype)
+    stats = _trace_stats(op, dims_t, nb_t, la, xo_t, grid, dtype, panel)
     rounds = stats["rounds"] * lat_scale
     cbytes = stats["bytes"] * byte_scale
     return CostBreakdown(
@@ -278,11 +311,12 @@ def _traced_cost(op: str, config: dict, ctx: TuneContext, grid, dtype,
         compute_s=_compute_seconds(op, ctx, nb, machine),
         latency_s=machine.latency_s * rounds,
         bandwidth_s=cbytes / machine.bw_bytes_per_s,
+        pivot_s=_pivot_seconds(op, ctx, config, machine),
         rounds=rounds, comm_bytes=cbytes,
         prim_counts={k: t["count"] for k, t in stats["totals"].items()},
         detail={"trace_dims": list(dims_t), "trace_nb": nb_t,
                 "trace_crossover": xo_t, "lat_scale": round(lat_scale, 3),
-                "byte_scale": round(byte_scale, 3)})
+                "byte_scale": round(byte_scale, 3), "panel": panel})
 
 
 # ---------------------------------------------------------------------

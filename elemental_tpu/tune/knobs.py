@@ -100,6 +100,40 @@ def _nb_only_space(ctx: TuneContext, pinned: dict) -> list:
     return [{"nb": nb} for nb in nbs]
 
 
+#: panel strategies of the pivoted/reflector factorizations (ISSUE 6):
+#: 'classic' = replicated column-at-a-time panel (the stability baseline),
+#: the alternative = communication-avoiding tree panel (CALU tournament
+#: pivoting for lu, TSQR R-reduction for qr).  'classic' leads so the
+#: deterministic tie-break keeps it on grids where the tree panel
+#: degenerates (single grid row: the slab IS the panel).
+LU_PANELS = ("classic", "calu")
+QR_PANELS = ("classic", "tsqr")
+
+
+def _with_panels(space: list, ctx: TuneContext, pinned: dict,
+                 panels: tuple) -> list:
+    chosen = (pinned["panel"],) if "panel" in pinned else panels
+    out = []
+    for cfg in space:
+        for pan in chosen:
+            if pan not in (panels[0],) and ctx.grid_shape[0] <= 1 \
+                    and "panel" not in pinned:
+                continue        # tree panel == classic on single-row grids
+            out.append({**cfg, "panel": pan})
+    return out
+
+
+def _lu_space(ctx: TuneContext, pinned: dict) -> list:
+    base = {k: v for k, v in pinned.items() if k != "panel"}
+    return _with_panels(_factorization_space(ctx, base), ctx, pinned,
+                        LU_PANELS)
+
+
+def _qr_space(ctx: TuneContext, pinned: dict) -> list:
+    base = {k: v for k, v in pinned.items() if k != "panel"}
+    return _with_panels(_nb_only_space(ctx, base), ctx, pinned, QR_PANELS)
+
+
 #: gemm candidate order doubles as the deterministic tie-break: on a 1x1
 #: grid every alg has zero comm cost and 'dot' early-outs to ONE local
 #: matmul (the pinned ``_summa_dot`` p==1 fast path), so it leads.
@@ -133,9 +167,9 @@ class OpSpace:
 OPS = {
     "cholesky": OpSpace("cholesky", ("nb", "lookahead", "crossover"),
                         _factorization_space),
-    "lu": OpSpace("lu", ("nb", "lookahead", "crossover"),
-                  _factorization_space),
-    "qr": OpSpace("qr", ("nb",), _nb_only_space),
+    "lu": OpSpace("lu", ("nb", "lookahead", "crossover", "panel"),
+                  _lu_space),
+    "qr": OpSpace("qr", ("nb", "panel"), _qr_space),
     "gemm": OpSpace("gemm", ("alg", "nb"), _gemm_space),
     "trsm": OpSpace("trsm", ("nb",), _nb_only_space),
     "herk": OpSpace("herk", ("nb",), _nb_only_space),

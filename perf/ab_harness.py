@@ -10,7 +10,8 @@ before/after pair).  Usage:
                                             #   nb + _INNERS sweep (dflt 16384)
     python perf/ab_harness.py cholesky [N]  # Cholesky: classic vs look-ahead
                                             #   x nb x crossover (dflt 16384)
-    python perf/ab_harness.py lu-dist [N]   # distributed LU: classic vs
+    python perf/ab_harness.py lu-dist [N]   # distributed LU: classic-panel
+                                            #   vs CALU tournament panel x
                                             #   look-ahead x tail crossover
                                             #   on ALL visible devices
     python perf/ab_harness.py phases [lu|cholesky] [N NB]
@@ -218,11 +219,13 @@ def run_lu(n=None):
 
 
 def run_lu_dist(n=None):
-    """ISSUE 3 A/B: distributed LU classic vs look-ahead x tail-crossover,
+    """ISSUE 3 + 6 A/B: distributed LU classic-panel vs CALU tournament
+    panel, each under classic and look-ahead x tail-crossover schedules,
     same process and grid (all visible devices), roofline-bracketed --
     the LU twin of :func:`run_cholesky`.  On a single device the
     crossover rows are skipped (the sequential path has no redistribution
-    tail to cross over from)."""
+    tail to cross over from) and calu degenerates to classic (single
+    grid row), so the tournament rows only appear on multi-row grids."""
     on_tpu = jax.devices()[0].platform != "cpu"
     n = int(n) if n else (16384 if on_tpu else 512)
     grid = el.Grid(jax.devices())
@@ -235,21 +238,33 @@ def run_lu_dist(n=None):
     def wrap(a):
         return el.DistMatrix(a, (n, n), el.MC, el.MR, 0, 0, grid)
 
-    # (name, lookahead, nb, crossover)
+    # (name, lookahead, nb, crossover, panel)
     cases = [
-        (f"classic        nb={nb0} xover=0", False, nb0, 0),
-        (f"look-ahead     nb={nb0} xover=0", True, nb0, 0),
+        (f"classic        nb={nb0} xover=0", False, nb0, 0, "classic"),
+        (f"look-ahead     nb={nb0} xover=0", True, nb0, 0, "classic"),
     ]
     if p > 1:
         for xo in (n // 8, n // 4, n // 2):
-            cases.append((f"look-ahead     nb={nb0} xover={xo}", True, nb0, xo))
+            cases.append((f"look-ahead     nb={nb0} xover={xo}",
+                          True, nb0, xo, "classic"))
         cases.append((f"classic        nb={nb0} xover={n // 4}",
-                      False, nb0, n // 4))
+                      False, nb0, n // 4, "classic"))
+    if grid.height > 1:
+        # the calu twins of the headline schedules: equal nb/crossover so
+        # every row pair is a pure panel-strategy A/B
+        cases.append((f"calu           nb={nb0} xover=0",
+                      True, nb0, 0, "calu"))
+        cases.append((f"calu classic-sched nb={nb0} xover=0",
+                      False, nb0, 0, "calu"))
+        for xo in (n // 8, n // 4):
+            cases.append((f"calu look-ahead nb={nb0} xover={xo}",
+                          True, nb0, xo, "calu"))
     print(f"grid {grid.height}x{grid.width}, n={n}", flush=True)
-    for name, la, nb, xo in cases:
+    for name, la, nb, xo, pan in cases:
         step = jax.jit(
-            lambda a, _nb=nb, _la=la, _xo=xo: tuple(el.lu(
-                a, nb=_nb, precision=HI, lookahead=_la, crossover=_xo))[0].local,
+            lambda a, _nb=nb, _la=la, _xo=xo, _p=pan: tuple(el.lu(
+                a, nb=_nb, precision=HI, lookahead=_la, crossover=_xo,
+                panel=_p))[0].local,
             donate_argnums=0)
         r0 = roofline()
         dt = timed(lambda: wrap(gen()), step)

@@ -53,6 +53,57 @@ def test_lookahead_strictly_fewer_all_gathers(la, classic):
     assert total_la < total_cl
 
 
+def _rounds(plan):
+    """Collective rounds = executed collectives (size-1 axes elide)."""
+    return sum(ev.count for ev in plan.events if ev.axis_size > 1)
+
+
+@pytest.mark.parametrize("calu,baselines", an.CALU_PAIRS,
+                         ids=[c for c, _ in an.CALU_PAIRS])
+def test_calu_strictly_fewer_rounds_per_panel(calu, baselines):
+    """ISSUE 6's acceptance pin: at equal n/nb (equal panel count) the
+    tournament-pivoted CALU schedule issues strictly fewer collective
+    rounds than BOTH classic-panel baselines on a real 2-D grid -- i.e.
+    strictly fewer rounds per panel.  The win is structural: the panel
+    permutation is one batched storage pass (zero explicit rounds) and
+    the row-block solve is one psum instead of the classic
+    all_to_all + all_gather pair."""
+    g = _grid(2, 2)
+    plan_ca, _, _ = an.trace_driver(calu, g)
+    for base in baselines:
+        plan_cl, _, _ = an.trace_driver(base, g)
+        assert _rounds(plan_ca) < _rounds(plan_cl), (
+            calu, plan_ca.totals(), base, plan_cl.totals())
+    # and strictly fewer all_gathers than even the pipelined baseline
+    plan_xo, _, _ = an.trace_driver("lu_crossover", g)
+    assert plan_ca.count("all_gather") < plan_xo.count("all_gather")
+    # the psum solve fully replaces the [STAR,VR] all_to_all dance
+    assert plan_ca.count("all_to_all") == 0
+    assert plan_ca.count("psum") > 0
+
+
+def test_tsqr_adds_no_collective_rounds():
+    """The QR tree panel is a replicated reduction: its comm plan must be
+    identical in round count to the classic panel's (the tree wins on
+    serial depth and MXU shape, never by adding communication)."""
+    g = _grid(2, 2)
+    plan_ts, _, _ = an.trace_driver("qr_tsqr", g)
+    plan_cl, _, _ = an.trace_driver("qr", g)
+    assert _rounds(plan_ts) == _rounds(plan_cl)
+
+
+def test_every_registered_driver_has_goldens():
+    """Registering an analysis variant without snapshotting its goldens
+    must fail loudly here (and in tools/check.sh's coverage gate), not
+    silently skip the new variant."""
+    import os
+    missing = [f"{d}@{r}x{c}" for d in an.driver_names() for (r, c) in GRIDS
+               if not os.path.exists(golden_path(d, (r, c)))]
+    assert not missing, (
+        f"registered driver variants without golden snapshots: {missing}; "
+        "run python -m perf.comm_audit diff <driver> --update-golden")
+
+
 @pytest.mark.parametrize("name", ["cholesky", "lu"])
 def test_driver_default_config_fewer_rounds_than_classic(name):
     """The DRIVER DEFAULTS (lookahead=True, crossover=None -> 4096) beat

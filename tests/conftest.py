@@ -37,6 +37,31 @@ import pytest  # noqa: E402
 
 from elemental_tpu import Grid  # noqa: E402
 
+# vm.max_map_count guard: every LoadedExecutable the suite compiles holds
+# mmapped JIT code pages, and one full-suite process accumulates tens of
+# thousands of mappings -- once the kernel cap (default 65530) is reached
+# XLA segfaults inside compile/deserialize.  The guard below watches this
+# process's mapping count after each test and drops jax's compilation
+# caches (releasing every executable's mappings) well before the cap; the
+# persistent compile cache above turns the forced recompiles into cheap
+# deserializes, so the cost is seconds per trip, not minutes.
+_MAPS_SOFT_CAP = 45_000
+
+
+def _n_mappings() -> int:
+    try:
+        with open("/proc/self/maps", "rb") as f:
+            return sum(1 for _ in f)
+    except OSError:            # non-Linux: no /proc, no known map cap
+        return 0
+
+
+@pytest.fixture(autouse=True)
+def _cap_executable_mappings():
+    yield
+    if _n_mappings() > _MAPS_SOFT_CAP:
+        jax.clear_caches()
+
 
 @pytest.fixture(scope="session", params=[(2, 4), (4, 2), (1, 8), (8, 1)],
                 ids=lambda rc: f"grid{rc[0]}x{rc[1]}")

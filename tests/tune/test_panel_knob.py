@@ -1,0 +1,135 @@
+"""The ``panel`` knob (ISSUE 6): registry space, cost-model pivot-latency
+term, and the pinned 'auto' ranking -- calu/tsqr on multi-row grids,
+classic on single-row ones (where the tree panels degenerate).
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+
+import elemental_tpu as el
+from elemental_tpu import tune
+from elemental_tpu.tune import TuneContext
+from elemental_tpu.tune import cost_model as cm
+from elemental_tpu.tune.knobs import (LU_PANELS, QR_PANELS, OPS,
+                                      candidate_configs)
+
+
+@pytest.fixture
+def empty_cache(tmp_path, monkeypatch):
+    from elemental_tpu.tune import cache as tc
+    monkeypatch.setenv(tc.ENV_DIR, str(tmp_path))
+    from elemental_tpu.tune.policy import clear_memo
+    clear_memo()
+    yield
+    clear_memo()
+
+
+def _grid(r, c):
+    return el.Grid(jax.devices()[: r * c], height=r)
+
+
+def _ctx(op, grid_shape, n=64):
+    return TuneContext(op, (n, n), "float32", grid_shape, "cpu")
+
+
+# ---------------------------------------------------------------------
+# registry space
+# ---------------------------------------------------------------------
+
+def test_lu_space_has_panel_dimension():
+    assert "panel" in OPS["lu"].knobs
+    assert "panel" in OPS["qr"].knobs
+    cfgs = candidate_configs(_ctx("lu", (2, 2)))
+    panels = {c["panel"] for c in cfgs}
+    assert panels == set(LU_PANELS)
+    qcfgs = candidate_configs(_ctx("qr", (2, 2)))
+    assert {c["panel"] for c in qcfgs} == set(QR_PANELS)
+
+
+def test_single_row_grids_enumerate_classic_only():
+    """On r == 1 the tree panels degenerate to classic, so the candidate
+    space drops them (unless explicitly pinned)."""
+    for gs in [(1, 1), (1, 8)]:
+        assert {c["panel"] for c in candidate_configs(_ctx("lu", gs))} \
+            == {"classic"}
+        assert {c["panel"] for c in candidate_configs(_ctx("qr", gs))} \
+            == {"classic"}
+    pinned = candidate_configs(_ctx("lu", (1, 1)), {"panel": "calu"})
+    assert all(c["panel"] == "calu" for c in pinned)
+
+
+# ---------------------------------------------------------------------
+# cost-model pivot-latency term
+# ---------------------------------------------------------------------
+
+def _score(op, grid, panel, n=64, nb=16):
+    ctx = _ctx(op, (grid.height, grid.width), n)
+    cfg = {"nb": nb, "panel": panel}
+    if op == "lu":
+        cfg.update(lookahead=True, crossover=0)
+    return cm.score_config(op, cfg, ctx=ctx, grid=grid, dtype=jnp.float32)
+
+
+def test_pivot_term_prefers_calu_on_multi_row_grids():
+    g = _grid(2, 2)
+    calu = _score("lu", g, "calu")
+    classic = _score("lu", g, "classic")
+    assert calu.pivot_s < classic.pivot_s
+    assert calu.total_s < classic.total_s
+    # the comm term agrees: the traced calu schedule has strictly fewer
+    # collective rounds (the one-psum solve replaces two rounds)
+    assert calu.rounds < classic.rounds
+
+
+def test_pivot_term_ties_on_single_row_grids():
+    g = _grid(1, 1)
+    calu = _score("lu", g, "calu")
+    classic = _score("lu", g, "classic")
+    assert calu.pivot_s == classic.pivot_s
+
+
+def test_qr_pivot_term_prefers_tsqr_on_multi_row_grids():
+    g = _grid(2, 2)
+    tsqr = _score("qr", g, "tsqr")
+    classic = _score("qr", g, "classic")
+    assert tsqr.pivot_s < classic.pivot_s
+    assert tsqr.total_s < classic.total_s
+
+
+# ---------------------------------------------------------------------
+# the pinned 'auto' ranking
+# ---------------------------------------------------------------------
+
+def test_auto_picks_calu_on_multi_row_classic_on_single_row(empty_cache):
+    res = tune.resolve("lu", gshape=(64, 64), dtype=jnp.float32,
+                       grid=_grid(2, 2), requested={"panel": "auto"})
+    assert res.source == "cost_model"
+    assert res.config["panel"] == "calu"
+    for grid in [_grid(1, 1), _grid(1, 8)]:
+        res1 = tune.resolve("lu", gshape=(64, 64), dtype=jnp.float32,
+                            grid=grid, requested={"panel": "auto"})
+        assert res1.config["panel"] == "classic"
+
+
+def test_auto_picks_tsqr_on_multi_row_grids(empty_cache):
+    res = tune.resolve("qr", gshape=(64, 64), dtype=jnp.float32,
+                       grid=_grid(2, 2), requested={"panel": "auto"})
+    assert res.config["panel"] == "tsqr"
+    res1 = tune.resolve("qr", gshape=(64, 64), dtype=jnp.float32,
+                        grid=_grid(1, 1), requested={"panel": "auto"})
+    assert res1.config["panel"] == "classic"
+
+
+def test_lu_driver_accepts_panel_auto(empty_cache):
+    """End-to-end: lu(panel='auto') resolves and factors correctly on a
+    multi-row grid (where 'auto' selects the tournament panel)."""
+    import numpy as np
+    g = _grid(2, 2)
+    rng = np.random.default_rng(80)
+    F = rng.normal(size=(24, 24)).astype(np.float32)
+    A = el.from_global(jnp.asarray(F), el.MC, el.MR, grid=g)
+    LU, perm = el.lu(A, nb=8, panel="auto")
+    lu_ = np.asarray(el.to_global(LU))
+    L = np.tril(lu_, -1) + np.eye(24, dtype=np.float32)
+    U = np.triu(lu_)
+    np.testing.assert_allclose(L @ U, F[np.asarray(perm)], rtol=0, atol=2e-4)

@@ -1,8 +1,10 @@
 #!/usr/bin/env bash
-# One-shot pre-commit gate (ISSUE 3 + 4 + 5): style lint + comm-plan lint +
-# golden comm-plan diff + autotuner cost-model self-check + the tier-1
-# tests/tune subset + the observability smoke (perf.trace run on a tiny
-# 1x1 problem) + the bench-trajectory regression gate (bench_diff).  Run
+# One-shot pre-commit gate (ISSUE 3 + 4 + 5 + 6): style lint + comm-plan
+# lint + golden comm-plan diff + autotuner cost-model self-check + the
+# tier-1 tests/tune subset + the calu/tsqr lapack gate (comm lint/diff on
+# the lu/qr variants, golden-coverage check, lu/qr tests) + the
+# observability smoke (perf.trace run on a tiny 1x1 problem) + the
+# bench-trajectory regression gate (bench_diff).  Run
 # from anywhere; exits non-zero on ANY finding.  Future PRs run this
 # before committing -- style/comm/explain are the cheap static slice (no
 # device execution); the tune/obs tests execute small factorizations on
@@ -14,6 +16,8 @@
 #   tools/check.sh comm     # comm-plan lint + golden diff only
 #   tools/check.sh tune     # cost-model self-check + tests/tune only
 #   tools/check.sh obs      # perf.trace smoke + bench_diff gate + tests/obs
+#   tools/check.sh lapack   # calu/tsqr gate: lu/qr comm lint + golden diff,
+#                           #   golden-coverage check, lapack lu/qr tests
 set -u
 cd "$(dirname "$0")/.."
 
@@ -45,6 +49,39 @@ if [ "$what" = "all" ] || [ "$what" = "tune" ]; then
     python -m perf.tune explain cholesky || rc=1
     echo "== tune tier-1 tests =="
     python -m pytest tests/tune -q -m 'not slow' -p no:cacheprovider || rc=1
+fi
+
+if [ "$what" = "all" ] || [ "$what" = "lapack" ]; then
+    echo "== calu/tsqr comm-plan lint + golden diff (lu + qr variants) =="
+    python -m perf.comm_audit lint lu || rc=1
+    python -m perf.comm_audit lint qr || rc=1
+    python -m perf.comm_audit diff lu || rc=1
+    python -m perf.comm_audit diff qr || rc=1
+    echo "== golden coverage: every registered driver variant has snapshots =="
+    # fail LOUDLY on a registered analysis variant with no golden snapshot
+    # (a variant that never got `comm_audit diff --update-golden` would
+    # otherwise only surface when the full diff --all gate runs)
+    python - <<'PY' || rc=1
+import os, sys
+sys.path.insert(0, os.getcwd())
+from perf.comm_audit import GRIDS, GOLDEN_DIR, golden_path, _bootstrap
+_bootstrap()
+from elemental_tpu import analysis as an
+missing = [f"{d} {r}x{c}" for d in an.driver_names() for (r, c) in GRIDS
+           if not os.path.exists(golden_path(d, (r, c)))]
+if missing:
+    print("MISSING golden snapshot(s) for registered driver variant(s):")
+    for m in missing:
+        print(f"  {m}   (run: python -m perf.comm_audit diff "
+              f"{m.split()[0]} --update-golden)")
+    sys.exit(1)
+print(f"golden coverage ok ({len(an.driver_names())} drivers x "
+      f"{len(GRIDS)} grids)")
+PY
+    echo "== lapack calu/tsqr tier-1 tests =="
+    python -m pytest tests/lapack/test_lu.py tests/lapack/test_lu_calu.py \
+        tests/lapack/test_qr.py tests/lapack/test_qr_tsqr.py \
+        -q -m 'not slow' -p no:cacheprovider || rc=1
 fi
 
 if [ "$what" = "all" ] || [ "$what" = "obs" ]; then
