@@ -236,7 +236,8 @@ def _local_cholesky(A: DistMatrix, nb: int | None, precision,
 
 def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
              precision=None, lookahead: bool | str = True,
-             crossover: int | str | None = None, timer=None) -> DistMatrix:
+             crossover: int | str | None = None, timer=None,
+             health=None) -> DistMatrix:
     """Cholesky factor of an HPD [MC,MR] matrix; reads only the ``uplo``
     triangle.  Returns L (A = L L^H) for 'L', U (A = U^H U) for 'U'.
 
@@ -251,6 +252,11 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
     tuning subsystem resolves them per (shape, dtype, grid, backend) --
     measured-cache winner first, analytic cost model cold (explicit
     values always win; see ``elemental_tpu/tune``).
+
+    ``health`` opts into the resilience guards (NaN/Inf scans, growth
+    estimate, non-positive/near-zero diagonal detection on the ``diag``
+    ticks): a ``HealthMonitor`` or ``True``, same semantics as
+    ``lu(..., health=...)``; ``None`` (default) attaches nothing.
     """
     _check_mcmr(A)
     if any(isinstance(v, str) for v in (nb, lookahead, crossover)):
@@ -264,7 +270,8 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
         # the upper triangle, conj-transposed, is the lower triangle.
         Alow = redistribute(transpose_dist(A, conj=True), MC, MR)
         L = cholesky(Alow, "L", nb=nb, precision=precision,
-                     lookahead=lookahead, crossover=crossover, timer=timer)
+                     lookahead=lookahead, crossover=crossover, timer=timer,
+                     health=health)
         return redistribute(transpose_dist(L, conj=True), MC, MR)
 
     m = A.gshape[0]
@@ -272,9 +279,16 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
         raise ValueError(f"cholesky needs square, got {A.gshape}")
     g = A.grid
     tm = _phase_hook("cholesky", timer)
+    hm = None
+    if health:
+        from ..resilience.health import attach_health
+        tm, hm = attach_health("cholesky", health, tm, scale_from=A)
     tm.start()
     if g.size == 1:
-        return _local_cholesky(A, nb, precision, lookahead, tm)
+        out = _local_cholesky(A, nb, precision, lookahead, tm)
+        if hm is not None:
+            hm.report()
+        return out
     r, c = g.height, g.width
     ib = _blocksize(nb, math.lcm(r, c), m)
     xover = (_CROSSOVER if lookahead else 0) if crossover is None \
@@ -386,20 +400,31 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
                             rows=(e, m), cols=(e, m))
             tm.tick("tail", k, L)
             break
+    if hm is not None:
+        hm.report()
     return make_trapezoidal(L, "L")
 
 
 def hpd_solve(A: DistMatrix, B: DistMatrix, uplo: str = "L",
-              nb: int | None = None, precision=None) -> DistMatrix:
+              nb: int | None = None, precision=None, info: bool = False,
+              health=None):
     """Solve A X = B for HPD A: Cholesky + forward/backward sweeps
-    (``El::HPDSolve``, ``src/lapack_like/solve/HPDSolve.cpp``)."""
-    if uplo.upper().startswith("U"):
-        U = cholesky(A, "U", nb=nb, precision=precision)
-        Y = trsm("L", "U", "C", U, B, nb=nb, precision=precision)
-        return trsm("L", "U", "N", U, Y, nb=nb, precision=precision)
-    L = cholesky(A, "L", nb=nb, precision=precision)
-    Y = trsm("L", "L", "N", L, B, nb=nb, precision=precision)
-    return trsm("L", "L", "C", L, Y, nb=nb, precision=precision)
+    (``El::HPDSolve``, ``src/lapack_like/solve/HPDSolve.cpp``).
+
+    ``info=True`` returns ``(X, info)`` with the structured singularity
+    signal ``{"singular", "diag_index", "finite"}`` from the factor's
+    diagonal (a singular / non-PD A surfaces as a non-finite or
+    non-positive diagonal entry instead of a silently NaN X; eager-mode
+    only); ``health`` forwards to :func:`cholesky`.  For the
+    residual-certified path use
+    ``elemental_tpu.resilience.certified_solve('hpd', A, B)``."""
+    uplo = "U" if uplo.upper().startswith("U") else "L"
+    F = cholesky(A, uplo, nb=nb, precision=precision, health=health)
+    X = cholesky_solve_after(F, B, uplo, nb=nb, precision=precision)
+    if not info:
+        return X
+    from ..resilience.health import factor_diag_info
+    return X, factor_diag_info("hpd", F)
 
 
 def cholesky_solve_after(L: DistMatrix, B: DistMatrix, uplo: str = "L",

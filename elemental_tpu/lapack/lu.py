@@ -582,7 +582,7 @@ _CROSSOVER = 4096
 def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
        update_precision=None, lookahead: bool | str = True,
        crossover: int | str | None = None, panel: str = "classic",
-       timer=None):
+       timer=None, health=None):
     """Blocked right-looking LU with partial pivoting and look-ahead.
 
     Returns (LU, perm): LU holds unit-lower L below the diagonal and U on
@@ -623,7 +623,16 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
     (shape, dtype, grid, backend) -- measured-cache winner first, analytic
     cost model cold; explicit values always win.  ``panel='auto'`` picks
     calu on multi-row grids and classic on single-row ones (the pivot
-    latency term of the cost model)."""
+    latency term of the cost model).
+
+    ``health`` opts into the resilience subsystem's numerical-health
+    guards (``elemental_tpu/resilience``): pass a ``HealthMonitor`` (read
+    ``monitor.report()`` afterwards) or ``True`` (report retrievable via
+    ``resilience.last_health_report('lu')``).  The monitor rides the same
+    tick hook as ``timer`` -- NaN/Inf scans, a growth-factor estimate,
+    and near-zero pivot detection at every phase boundary, engine-free.
+    ``health=None`` (default) attaches nothing: the zero-overhead
+    NULL_HOOK path, pinned by the redist-count goldens."""
     _check_mcmr(A)
     if any(isinstance(v, str) for v in (nb, lookahead, crossover)) \
             or panel == "auto":
@@ -641,8 +650,15 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
     m, n = A.gshape
     g = A.grid
     tm = _phase_hook("lu", timer)
+    hm = None
+    if health:
+        from ..resilience.health import attach_health
+        tm, hm = attach_health("lu", health, tm, scale_from=A)
     if g.size == 1:
-        return _local_lu(A, nb, precision, update_precision, lookahead, tm)
+        out = _local_lu(A, nb, precision, update_precision, lookahead, tm)
+        if hm is not None:
+            hm.report()
+        return out
     r, c = g.height, g.width
     calu = panel == "calu" and r > 1
 
@@ -785,6 +801,8 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
             A, perm = _lu_tail(A, perm, e, ib, precision, upd, lookahead,
                                tm, k)
             break
+    if hm is not None:
+        hm.report()
     return A, perm
 
 
@@ -837,14 +855,28 @@ def _update_cols_ge(A, block, rows, cols, e):
 
 
 def lu_solve(A: DistMatrix, B: DistMatrix, nb: int | None = None,
-             precision=None, panel: str = "classic") -> DistMatrix:
+             precision=None, panel: str = "classic", info: bool = False,
+             health=None):
     """Solve A X = B via LU with partial pivoting (``El::LinearSolve``,
     ``src/lapack_like/solve/LinearSolve.cpp``: LU + SolveAfter).
     ``panel`` selects the factorization's panel strategy (see :func:`lu`);
     the solve-after path is strategy-agnostic -- it only consumes the
-    packed factor and the composed permutation."""
-    LU_, perm = lu(A, nb=nb, precision=precision, panel=panel)
-    return lu_solve_after(LU_, perm, B, nb=nb, precision=precision)
+    packed factor and the composed permutation.
+
+    ``info=True`` returns ``(X, info)`` where ``info`` is the structured
+    singularity signal ``{"singular", "diag_index", "finite"}`` from the
+    factor's diagonal (an exactly-singular A surfaces as a zero pivot
+    instead of a silently NaN/Inf X -- eager-mode only, like ``timer``);
+    ``health`` forwards to :func:`lu` (the resilience guards).  For the
+    full residual-certified path use
+    ``elemental_tpu.resilience.certified_solve('lu', A, B)``."""
+    LU_, perm = lu(A, nb=nb, precision=precision, panel=panel,
+                   health=health)
+    X = lu_solve_after(LU_, perm, B, nb=nb, precision=precision)
+    if not info:
+        return X
+    from ..resilience.health import factor_diag_info
+    return X, factor_diag_info("lu", LU_)
 
 
 def lu_solve_after(LU_: DistMatrix, perm, B: DistMatrix, nb: int | None = None,

@@ -23,9 +23,9 @@ the bench trajectory: ``tools/bench_diff.py`` (wired into
 from .metrics import (SCHEMA as METRICS_SCHEMA, MetricsRegistry, REGISTRY,
                       current as current_metrics, scoped as metrics_scope,
                       inc, observe, set_gauge)
-from .tracer import (TRACE_SCHEMA, CommEvent, NullHook, NULL_HOOK,
-                     PhaseRecord, Span, Tracer, active_tracer, phase_hook,
-                     ring_bytes)
+from .tracer import (TRACE_SCHEMA, CommEvent, InstantEvent, NullHook,
+                     NULL_HOOK, PhaseRecord, Span, Tracer, active_tracer,
+                     phase_hook, ring_bytes)
 from .phase_timer import PHASES, SCHEMA as PHASE_TIMINGS_SCHEMA, PhaseTimer
 from .export import (CHROME_SCHEMA, chrome_trace_doc,
                      phase_timings_to_chrome, write_json)
@@ -33,8 +33,9 @@ from .export import (CHROME_SCHEMA, chrome_trace_doc,
 __all__ = [
     "METRICS_SCHEMA", "MetricsRegistry", "REGISTRY", "current_metrics",
     "metrics_scope", "inc", "observe", "set_gauge",
-    "TRACE_SCHEMA", "CommEvent", "NullHook", "NULL_HOOK", "PhaseRecord",
-    "Span", "Tracer", "active_tracer", "phase_hook", "ring_bytes",
+    "TRACE_SCHEMA", "CommEvent", "InstantEvent", "NullHook", "NULL_HOOK",
+    "PhaseRecord", "Span", "Tracer", "active_tracer", "phase_hook",
+    "ring_bytes",
     "PHASES", "PHASE_TIMINGS_SCHEMA", "PhaseTimer",
     "CHROME_SCHEMA", "chrome_trace_doc", "phase_timings_to_chrome",
     "write_json",

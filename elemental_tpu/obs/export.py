@@ -55,7 +55,8 @@ def _lanes(phase_names) -> dict:
     return lanes
 
 
-def _meta_events(lanes: dict, have_comms: bool) -> list:
+def _meta_events(lanes: dict, have_comms: bool,
+                 have_instants: bool = False) -> list:
     evs = [{"ph": "M", "pid": _PID, "tid": _TID_DRIVER, "name": "thread_name",
             "args": {"name": "drivers"}},
            {"ph": "M", "pid": _PID, "tid": _TID_STEP, "name": "thread_name",
@@ -66,6 +67,10 @@ def _meta_events(lanes: dict, have_comms: bool) -> list:
     if have_comms:
         evs.append({"ph": "M", "pid": _PID, "tid": _comm_tid(lanes),
                     "name": "thread_name", "args": {"name": "collectives"}})
+    if have_instants:
+        evs.append({"ph": "M", "pid": _PID,
+                    "tid": _instant_tid(lanes, have_comms),
+                    "name": "thread_name", "args": {"name": "events"}})
     return evs
 
 
@@ -73,18 +78,24 @@ def _comm_tid(lanes: dict) -> int:
     return (max(lanes.values()) + 1) if lanes else _FIRST_PHASE_TID
 
 
+def _instant_tid(lanes: dict, have_comms: bool) -> int:
+    return _comm_tid(lanes) + (1 if have_comms else 0)
+
+
 def chrome_trace_doc(tracer: Tracer, **meta) -> dict:
     """Render a tracer's spans/phases/collectives as a Chrome trace."""
+    instants = getattr(tracer, "instants", ())
     times = ([r.t0 for r in tracer.phases]
              + [s.t0 for s in tracer.spans]
-             + [ev.t for ev in tracer.comms])
+             + [ev.t for ev in tracer.comms]
+             + [ev.t for ev in instants])
     origin = min(times) if times else 0.0
 
     def us(t: float) -> float:
         return round((t - origin) * 1e6, 3)
 
     lanes = _lanes({r.phase for r in tracer.phases})
-    events = _meta_events(lanes, bool(tracer.comms))
+    events = _meta_events(lanes, bool(tracer.comms), bool(instants))
 
     # synthesized driver spans (one per tick channel) on the driver track
     for call, driver, t0, t1, steps in tracer.driver_calls():
@@ -129,6 +140,12 @@ def chrome_trace_doc(tracer: Tracer, **meta) -> dict:
                        "args": {"kind": ev.kind, "gshape": list(ev.gshape),
                                 "dtype": ev.dtype, "bytes": ev.bytes,
                                 "driver": ev.driver, "span": ev.span}})
+    # generic instants (health flags, ...) on a dedicated events track
+    etid = _instant_tid(lanes, bool(tracer.comms))
+    for ev in instants:
+        events.append({"ph": "i", "s": "t", "pid": _PID, "tid": etid,
+                       "name": ev.name, "ts": us(ev.t),
+                       "args": dict(ev.attrs)})
     return {"schema": CHROME_SCHEMA, "traceEvents": events,
             "displayTimeUnit": "ms", "otherData": dict(meta)}
 

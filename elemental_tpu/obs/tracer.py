@@ -78,6 +78,14 @@ class PhaseRecord:
 
 
 @dataclasses.dataclass
+class InstantEvent:
+    """One generic instant event (e.g. a resilience health flag)."""
+    t: float
+    name: str
+    attrs: dict
+
+
+@dataclasses.dataclass
 class CommEvent:
     """One public redistribute/panel_spread entry observed at runtime."""
     t: float
@@ -186,6 +194,7 @@ class Tracer:
         self.spans: list[Span] = []
         self.phases: list[PhaseRecord] = []
         self.comms: list[CommEvent] = []
+        self.instants: list[InstantEvent] = []
         self._stack: list[Span] = []
         self._metrics = metrics
         self._ncalls = 0
@@ -223,6 +232,15 @@ class Tracer:
         if self._metrics:
             _metrics.observe("phase_seconds", t1 - t0, driver=driver,
                              phase=phase)
+
+    # ---- generic instant events -------------------------------------
+    def instant(self, name: str, **attrs) -> None:
+        """Record a zero-duration event (rendered on an ``events`` track
+        by the Chrome-trace exporter).  The resilience health guards use
+        this to surface ``health:<kind>`` flags inline with the phase
+        spans of the run that produced them."""
+        self.instants.append(InstantEvent(t=self.clock(), name=str(name),
+                                          attrs=dict(attrs)))
 
     # ---- engine observer --------------------------------------------
     def _on_redist(self, rec) -> None:

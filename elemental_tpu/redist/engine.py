@@ -149,6 +149,33 @@ def add_redist_observer(cb) -> callable:
     return remove
 
 
+# ---------------------------------------------------------------------
+# fault-injection seam (elemental_tpu.resilience, ISSUE 7): a seeded
+# FaultPlan installed here corrupts chosen public redistribute /
+# panel_spread payloads, so the certified-solve tests can prove each
+# corruption class is repaired by escalation or surfaced as a health
+# report.  None (the default) is the zero-overhead path.
+# ---------------------------------------------------------------------
+
+_FAULT_INJECTOR = None
+
+
+@contextlib.contextmanager
+def fault_injection(plan):
+    """Install ``plan`` (a ``resilience.faults.FaultPlan``, or anything
+    with ``apply(target, outputs) -> outputs``) as the engine's fault
+    injector for the block; the previous injector is restored on exit.
+    Every public :func:`redistribute` / :func:`panel_spread` entry routes
+    its output local array(s) through ``plan.apply`` before returning."""
+    global _FAULT_INJECTOR
+    prev = _FAULT_INJECTOR
+    _FAULT_INJECTOR = plan
+    try:
+        yield plan
+    finally:
+        _FAULT_INJECTOR = prev
+
+
 def _trace_record(kind, src, dst, gshape, dtype, objs_in, objs_out,
                   grid_shape=()):
     if _REDIST_TRACE is None and not _REDIST_OBSERVERS:
@@ -711,6 +738,10 @@ def panel_spread(A: DistMatrix, conj: bool = True):
                          f"panel, got {A}")
     REDIST_COUNTS["panel_spread"] += 1
     mc, mr = _panel_spread_jit(A, conj)
+    if _FAULT_INJECTOR is not None:
+        lmc, lmr = _FAULT_INJECTOR.apply("panel_spread",
+                                         (mc.local, mr.local))
+        mc, mr = mc.with_local(lmc), mr.with_local(lmr)
     _trace_record("panel_spread", A.dist, ((MC, STAR), (STAR, MR)),
                   A.gshape, A.dtype, A.local, (mc.local, mr.local),
                   grid_shape=(A.grid.height, A.grid.width))
@@ -874,6 +905,9 @@ def redistribute(A: DistMatrix, cdist: Dist, rdist: Dist,
         out = _redistribute_circ(A, cdist, rdist, calign, ralign)
     else:
         out = _redistribute_jit(A, cdist, rdist, calign, ralign)
+    if _FAULT_INJECTOR is not None:
+        out = out.with_local(
+            _FAULT_INJECTOR.apply("redistribute", (out.local,))[0])
     _trace_record("redistribute", A.dist, (cdist, rdist), A.gshape,
                   A.dtype, A.local, (out.local,),
                   grid_shape=(A.grid.height, A.grid.width))

@@ -1,0 +1,36 @@
+"""Resilience: numerical-health guards, certified solves, fault injection.
+
+The robustness subsystem (ISSUE 7).  At the scale the source paper
+targets (multi-thousand-chip factorizations, arXiv 2112.09017), silent
+NaN / growth blowups are the failure mode, not crashes -- this package
+makes numerical corruption DETECTED, REPORTED, and RECOVERED:
+
+  :mod:`.health`   per-phase health guards riding the driver tick-hook
+                   seam (``lu(..., health=...)``) -> ``health_report/v1``
+  :mod:`.certify`  ``certified_solve``: true-residual certificate +
+                   iterative refinement + the deterministic escalation
+                   ladder (fast -> refine -> fp32 -> classic)
+  :mod:`.faults`   seeded ``FaultPlan`` corruption of engine payloads
+                   (install via :func:`fault_injection`, the
+                   ``redist.engine`` seam) -- the test harness proving
+                   every corruption class is repaired or surfaced
+
+CLI: ``python -m perf.certify {run,smoke}``; gate: ``tools/check.sh
+resilience``.
+"""
+from ..redist.engine import fault_injection
+from .health import (HEALTH_SCHEMA, HealthMonitor, attach_health,
+                     factor_diag_info, last_health_report)
+from .certify import (CERT_SCHEMA, LADDER_NAMES, Rung, certified_solve,
+                      default_ladder, default_tol)
+from .faults import (FAULT_KINDS, FAULT_TARGETS, FaultEvent, FaultPlan,
+                     FaultSpec, logs_identical)
+
+__all__ = [
+    "HEALTH_SCHEMA", "HealthMonitor", "attach_health", "factor_diag_info",
+    "last_health_report",
+    "CERT_SCHEMA", "LADDER_NAMES", "Rung", "certified_solve",
+    "default_ladder", "default_tol",
+    "FAULT_KINDS", "FAULT_TARGETS", "FaultEvent", "FaultPlan", "FaultSpec",
+    "logs_identical", "fault_injection",
+]

@@ -1,0 +1,278 @@
+"""Certified solves: residual certificate + deterministic escalation.
+
+The recovery half of the resilience subsystem (ISSUE 7).
+:func:`certified_solve` wraps the ``lu_solve`` / ``hpd_solve`` drivers
+into the retry/backoff shape for NUMERICAL failure: run the fast
+configuration first, measure the TRUE residual through a trusted path,
+iteratively refine, and on certification failure climb a deterministic
+escalation ladder -- re-using the tuner's knob vocabulary for each rung's
+configuration (``panel`` / ``update_precision`` / ``precision`` /
+``lookahead``; see ``tune.knobs``) -- until a rung certifies or the
+ladder is exhausted.
+
+The ladder (order pinned by ``tests/resilience``)::
+
+    fast     speed-first factorization: CALU tournament panel (lu) /
+             default-precision trailing updates, small refinement budget
+    refine   SAME factor, larger iterative-refinement budget (cheapest
+             escalation: no refactorization)
+    fp32     refactor with full-precision trailing updates
+    classic  refactor with the classic (partial-pivot / classic-schedule)
+             panel -- the maximum-stability baseline
+
+This is the residual certificate the ROADMAP's quantized-collectives
+item (EQuARX, arXiv 2506.17615) requires before aggressive
+``comm_precision`` can ship: any future rung that cheapens communication
+slots in ABOVE ``fast`` and inherits the same certify-or-escalate
+contract.
+
+Trust boundary: the certificate's residual is computed HOST-SIDE in
+float64 from ``to_global`` snapshots (pure storage gathers -- no engine
+collectives), so a fault-injected or otherwise corrupted redistribution
+layer (see :mod:`.faults`) can corrupt the SOLVE but never the
+MEASUREMENT: a garbage solution cannot be certified, and a clean
+escalation rung certifies even while lower rungs are being corrupted.
+Each factorization attempt runs under its own
+:class:`~elemental_tpu.resilience.health.HealthMonitor`, so a failed
+certificate carries the health report naming the failing phase.
+
+``solve_certificate/v1`` (the ``info`` return)::
+
+    {"schema": "solve_certificate/v1", "op": "lu", "certified": true,
+     "rung": "fast",                  # certifying rung (None on failure)
+     "residual": 3.1e-15, "tol": 6.8e-13,
+     "refine_iters": 0,              # iterations at the certifying rung
+     "ladder": ["fast", "refine", "fp32", "classic"],
+     "attempts": [{"rung", "residual", "refine_iters", "singular",
+                   "diag_index", "health"}, ...],
+     "singular": false,              # every attempted factor was singular
+     "failing_phase": null,          # first health-flagged phase /
+                                     #   "diag" (singular) / "residual"
+     "health": {...}}                # last attempt's health_report/v1
+
+The residual certified is ``||B - A X||_F / (||A||_F ||X||_F + ||B||_F)``
+(normwise relative backward error); the documented default tolerance is
+``64 * n * eps(A.dtype)``.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .health import HealthMonitor
+
+CERT_SCHEMA = "solve_certificate/v1"
+
+#: documented default certification tolerance: ``TOL_FACTOR * n * eps``
+TOL_FACTOR = 64.0
+
+#: canonical ladder rung names, in escalation order (pinned by tests)
+LADDER_NAMES = ("fast", "refine", "fp32", "classic")
+
+
+@dataclasses.dataclass(frozen=True)
+class Rung:
+    """One escalation rung: a driver knob configuration + budgets."""
+    name: str
+    config: dict                 # driver kwargs (tuner knob vocabulary)
+    refine: int                  # iterative-refinement budget
+    refactor: bool = True        # fresh factorization at this rung?
+
+
+def default_ladder(op: str):
+    """The documented ladder for ``op`` ('lu' | 'hpd').  Rung configs are
+    knob dicts in the tuner's vocabulary (``tune.knobs``): 'fast' rides
+    the ISSUE-6 CALU panel (``LU_PANELS[1]``; degenerates to classic on
+    single-row grids inside the driver) with default-precision trailing
+    updates, 'classic' is ``LU_PANELS[0]`` / the classic schedule."""
+    from jax import lax
+    if op == "lu":
+        from ..tune.knobs import LU_PANELS
+        classic, calu = LU_PANELS
+        fast = {"panel": calu, "update_precision": lax.Precision.DEFAULT}
+        return (
+            Rung("fast", fast, refine=2),
+            Rung("refine", fast, refine=8, refactor=False),
+            Rung("fp32", {"panel": calu, "update_precision": None},
+                 refine=4),
+            Rung("classic", {"panel": classic, "update_precision": None},
+                 refine=4),
+        )
+    if op == "hpd":
+        fast = {"precision": None}
+        return (
+            Rung("fast", fast, refine=2),
+            Rung("refine", fast, refine=8, refactor=False),
+            Rung("fp32", {"precision": lax.Precision.HIGHEST}, refine=4),
+            Rung("classic", {"precision": lax.Precision.HIGHEST,
+                             "lookahead": False}, refine=4),
+        )
+    raise ValueError(f"certified_solve op must be 'lu' or 'hpd', got {op!r}")
+
+
+def default_tol(n: int, dtype) -> float:
+    import jax.numpy as jnp
+    return TOL_FACTOR * max(int(n), 1) * float(jnp.finfo(dtype).eps)
+
+
+# ---------------------------------------------------------------------
+# trusted host-side measurement (engine-free: to_global is a storage take)
+# ---------------------------------------------------------------------
+
+def _host(A) -> np.ndarray:
+    from ..core.distmatrix import to_global
+    arr = np.asarray(to_global(A))
+    return arr.astype(np.complex128 if np.iscomplexobj(arr) else np.float64)
+
+
+def _residual(An, Bn, Xn, normA, normB) -> float:
+    # corrupted solves legitimately overflow here; inf is the verdict
+    with np.errstate(over="ignore", invalid="ignore"):
+        r = Bn - An @ Xn
+        normX = np.linalg.norm(Xn)
+        den = normA * normX + normB
+        if not np.isfinite(den) or den == 0.0:
+            return float("inf")
+        res = np.linalg.norm(r) / den
+    return float(res) if np.isfinite(res) else float("inf")
+
+
+# ---------------------------------------------------------------------
+# per-op factor / solve-after adapters
+# ---------------------------------------------------------------------
+
+def _factor(op: str, A, nb, config: dict, monitor):
+    if op == "lu":
+        from ..lapack.lu import lu
+        return lu(A, nb=nb, health=monitor, **config)
+    from ..lapack.cholesky import cholesky
+    return cholesky(A, "L", nb=nb, health=monitor, **config)
+
+
+def _solve_after(op: str, factor, B, nb):
+    if op == "lu":
+        from ..lapack.lu import lu_solve_after
+        LU_, perm = factor
+        return lu_solve_after(LU_, perm, B, nb=nb)
+    from ..lapack.cholesky import cholesky_solve_after
+    return cholesky_solve_after(factor, B, "L", nb=nb)
+
+
+def _factor_matrix(op: str, factor):
+    return factor[0] if op == "lu" else factor
+
+
+# ---------------------------------------------------------------------
+# the certified solve
+# ---------------------------------------------------------------------
+
+def certified_solve(op: str, A, B, *, tol: float | None = None,
+                    nb: int | None = None, ladder=None, health: bool = True):
+    """Solve ``A X = B`` with a residual certificate and escalation.
+
+    ``op``: ``'lu'`` (general square A) or ``'hpd'`` (Hermitian positive
+    definite A; ``'cholesky'`` is accepted as an alias).  Returns
+    ``(X, info)`` with ``info`` a ``solve_certificate/v1`` document (see
+    module docstring); ``X`` is the best solution produced (``None`` only
+    when every attempted factorization was singular).  ``tol`` defaults
+    to the documented ``64 * n * eps(A.dtype)``; ``ladder`` overrides the
+    rung sequence (a tuple of :class:`Rung`); ``health=False`` skips the
+    per-attempt health monitors (the certificate alone still guards the
+    result).  EAGER-mode: the escalation control flow is host-side.
+    """
+    if op == "cholesky":
+        op = "hpd"
+    rungs = tuple(ladder) if ladder is not None else default_ladder(op)
+    n = int(A.gshape[0])
+    if tol is None:
+        tol = default_tol(n, A.dtype)
+    tol = float(tol)
+    An = _host(A)
+    Bn = _host(B)
+    normA = np.linalg.norm(An)
+    normB = np.linalg.norm(Bn)
+    dtype = np.dtype(B.dtype)
+
+    from .health import factor_diag_info
+    attempts: list = []
+    factor = None
+    diag = None
+    monitor = None
+    X = None
+    for rung in rungs:
+        att = {"rung": rung.name, "residual": None, "refine_iters": 0,
+               "singular": False, "diag_index": None, "health": None}
+        if rung.refactor or factor is None:
+            monitor = HealthMonitor() if health else None
+            factor = _factor(op, A, nb, rung.config, monitor)
+            diag = factor_diag_info(op, _factor_matrix(op, factor))
+        if monitor is not None:
+            att["health"] = monitor.report()
+        att["singular"] = diag["singular"]
+        att["diag_index"] = diag["diag_index"]
+        if diag["singular"]:
+            attempts.append(att)
+            continue                      # solve-after would be garbage
+        X = _solve_after(op, factor, B, nb)
+        res = _residual(An, Bn, _host(X), normA, normB)
+        it = 0
+        while res > tol and it < rung.refine and np.isfinite(res):
+            with np.errstate(over="ignore", invalid="ignore"):
+                Rn = Bn - An @ _host(X)
+            if not np.isfinite(Rn).all():
+                break
+            from ..core.distmatrix import from_global
+            from ..core.dist import MC, MR
+            Rd = from_global(Rn.astype(dtype), MC, MR, grid=B.grid)
+            D = _solve_after(op, factor, Rd, nb)
+            X = X.with_local(X.local + D.local)
+            it += 1
+            new = _residual(An, Bn, _host(X), normA, normB)
+            if not (new < 0.9 * res):
+                res = min(res, new)
+                break                     # refinement stalled: escalate
+            res = new
+        att["residual"] = res if np.isfinite(res) else None
+        att["refine_iters"] = it
+        attempts.append(att)
+        if np.isfinite(res) and res <= tol:
+            return X, _certificate(op, True, rung.name, res, tol, it,
+                                   rungs, attempts)
+    last = attempts[-1] if attempts else None
+    res = last["residual"] if last else None
+    return X, _certificate(op, False, None,
+                           res if res is not None else float("nan"),
+                           tol, last["refine_iters"] if last else 0,
+                           rungs, attempts)
+
+
+def _failing_phase(attempts) -> str | None:
+    for att in attempts:
+        rep = att.get("health")
+        if rep and rep.get("flags"):
+            return rep["flags"][0]["phase"]
+    for att in attempts:
+        if att.get("singular"):
+            return "diag"
+    return "residual"
+
+
+def _certificate(op, certified, rung, residual, tol, iters, rungs,
+                 attempts) -> dict:
+    last_health = None
+    for att in reversed(attempts):
+        if att.get("health") is not None:
+            last_health = att["health"]
+            break
+    return {"schema": CERT_SCHEMA, "op": op, "certified": bool(certified),
+            "rung": rung,
+            "residual": None if residual is None or not np.isfinite(residual)
+            else float(residual),
+            "tol": float(tol), "refine_iters": int(iters),
+            "ladder": [r.name for r in rungs],
+            "attempts": attempts,
+            "singular": bool(attempts) and all(a["singular"]
+                                               for a in attempts),
+            "failing_phase": None if certified else _failing_phase(attempts),
+            "health": last_health}

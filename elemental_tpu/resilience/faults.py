@@ -1,0 +1,208 @@
+"""Deterministic fault injection for the redistribution engine.
+
+The adversarial half of the resilience subsystem (ISSUE 7): a seeded
+:class:`FaultPlan` installs into ``redist.engine`` (the one choke point
+every driver's data motion routes through -- the same seam the ISSUE-5
+observers ride) and corrupts CHOSEN public ``redistribute`` /
+``panel_spread`` payloads on CHOSEN calls, so tests can *prove* each
+corruption class is either repaired by the certified-solve escalation
+ladder or surfaced as a health report -- never silently propagated into
+results.
+
+Determinism is the contract: every corruption site derives its own
+``numpy`` Generator from ``(seed, target, call index, output index,
+kind)``, so an identical plan replayed over an identical run produces
+BIT-IDENTICAL corrupted payloads (pinned by
+``tests/resilience/test_faults.py``); the :attr:`FaultPlan.log` records
+(flat indices, before, after) per event for exactly that comparison.
+
+Corruption classes (``FaultSpec.kind``):
+
+  * ``'bitflip'``  -- XOR one high (exponent-region) bit of each chosen
+    element: the single-event-upset model;
+  * ``'scale'``    -- multiply chosen elements by ``FaultSpec.factor``
+    (default 1e12): the growth-blowup model, finite but catastrophic;
+  * ``'nan'``      -- splat NaN: the poisoned-collective model.
+
+Targets (``FaultSpec.target``): ``'redistribute'`` and ``'panel_spread'``
+-- the engine's two public data-motion entries.  Call indices count
+Python-level entries per target (the same counting semantics as
+``engine.REDIST_COUNTS``), starting at 0 when the plan is installed;
+``every=True`` corrupts every call from ``call`` onward (the persistent-
+corruption mode certified solves must SURFACE, vs the one-shot mode they
+must REPAIR).
+
+Like the tracer and the health monitor this is an EAGER-mode tool: a
+payload that is still a jax tracer (an enclosing jit) is counted but
+passed through uncorrupted.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+FAULT_KINDS = ("bitflip", "scale", "nan")
+FAULT_TARGETS = ("redistribute", "panel_spread")
+
+#: stable per-target / per-kind seed words (never reorder: part of the
+#: determinism contract -- a plan's corruption stream is pinned by tests)
+_TARGET_WORD = {t: i + 1 for i, t in enumerate(FAULT_TARGETS)}
+_KIND_WORD = {k: i + 1 for i, k in enumerate(FAULT_KINDS)}
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One corruption rule of a plan."""
+    target: str                  # "redistribute" | "panel_spread"
+    kind: str                    # "bitflip" | "scale" | "nan"
+    call: int = 0                # nth public entry of ``target`` (0-based)
+    every: bool = False          # corrupt every call index >= ``call``
+    nelem: int = 1               # elements corrupted per payload array
+    factor: float = 1e12         # 'scale' multiplier
+
+    def __post_init__(self):
+        if self.target not in FAULT_TARGETS:
+            raise ValueError(f"unknown fault target {self.target!r}; "
+                             f"expected one of {FAULT_TARGETS}")
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; "
+                             f"expected one of {FAULT_KINDS}")
+        if self.call < 0 or self.nelem < 1:
+            raise ValueError("FaultSpec needs call >= 0 and nelem >= 1")
+
+    def matches(self, target: str, call: int) -> bool:
+        return self.target == target and \
+            (call >= self.call if self.every else call == self.call)
+
+
+@dataclasses.dataclass
+class FaultEvent:
+    """One applied corruption (host copies -- the determinism evidence)."""
+    target: str
+    call: int
+    output: int                  # index within the entry's output tuple
+    kind: str
+    shape: tuple
+    dtype: str
+    indices: np.ndarray          # flat element indices corrupted
+    before: np.ndarray
+    after: np.ndarray
+
+
+class FaultPlan:
+    """A seeded, replayable corruption schedule (see module docstring).
+
+    Install with ``redist.engine.fault_injection(plan)`` (re-exported as
+    ``elemental_tpu.resilience.fault_injection``); :meth:`reset` rewinds
+    the call counters and the log so the SAME plan object can replay a
+    second identical run for bit-identity comparison."""
+
+    def __init__(self, seed: int, faults):
+        self.seed = int(seed)
+        self.faults = tuple(faults)
+        for f in self.faults:
+            if not isinstance(f, FaultSpec):
+                raise TypeError(f"FaultPlan needs FaultSpec entries, got "
+                                f"{type(f).__name__}")
+        self.calls: dict = {t: 0 for t in FAULT_TARGETS}
+        self.log: list[FaultEvent] = []
+
+    def reset(self) -> "FaultPlan":
+        self.calls = {t: 0 for t in FAULT_TARGETS}
+        self.log = []
+        return self
+
+    # ---- the engine-facing entry ------------------------------------
+    def apply(self, target: str, outputs: tuple) -> tuple:
+        """Count one public ``target`` entry and return the (possibly
+        corrupted) output arrays.  Tracer payloads pass through."""
+        call = self.calls[target]
+        self.calls[target] = call + 1
+        specs = [f for f in self.faults if f.matches(target, call)]
+        if not specs:
+            return tuple(outputs)
+        import jax
+        if any(isinstance(o, jax.core.Tracer) for o in outputs):
+            return tuple(outputs)         # inside jit: eager-only tool
+        out = list(outputs)
+        for spec in specs:
+            for oi, arr in enumerate(out):
+                out[oi] = self._corrupt(arr, spec, target, call, oi)
+        return tuple(out)
+
+    # ---- corruption kernels -----------------------------------------
+    def _corrupt(self, arr, spec: FaultSpec, target: str, call: int,
+                 oi: int):
+        import jax.numpy as jnp
+        dt = np.dtype(arr.dtype)
+        if not np.issubdtype(dt, np.inexact) or arr.size == 0:
+            return arr
+        rng = np.random.default_rng(
+            [self.seed, _TARGET_WORD[target], call, oi,
+             _KIND_WORD[spec.kind]])
+        n = int(arr.size)
+        k = min(int(spec.nelem), n)
+        idx = np.sort(rng.choice(n, size=k, replace=False))
+        host = np.asarray(arr)
+        before = host.reshape(-1)[idx].copy()
+        after = self._values(before, spec, rng, dt)
+        coords = np.unravel_index(idx, host.shape)
+        new = arr.at[tuple(jnp.asarray(c) for c in coords)].set(
+            jnp.asarray(after))
+        self.log.append(FaultEvent(
+            target=target, call=call, output=oi, kind=spec.kind,
+            shape=tuple(host.shape), dtype=dt.name,
+            indices=idx, before=before, after=after.copy()))
+        return new
+
+    @staticmethod
+    def _values(before: np.ndarray, spec: FaultSpec, rng, dt) -> np.ndarray:
+        if spec.kind == "nan":
+            return np.full_like(before, np.nan)
+        if spec.kind == "scale":
+            return (before * before.dtype.type(spec.factor)).astype(dt)
+        # bitflip: XOR one exponent-region bit per element (complex flips
+        # the real component's representation)
+        vals = before.copy()
+        comp = np.iscomplexobj(vals)
+        re = np.ascontiguousarray(vals.real) if comp else vals
+        fdt = re.dtype
+        udt = np.dtype(f"uint{fdt.itemsize * 8}")
+        bits = fdt.itemsize * 8
+        # mantissa-top .. exponent bits: always a macroscopic change, never
+        # the sign bit alone
+        b = rng.integers(bits - 12, bits - 1, size=vals.shape)
+        mask = np.left_shift(np.ones_like(b, dtype=udt), b.astype(udt))
+        flipped = (re.view(udt) ^ mask).view(fdt)
+        if comp:
+            return (flipped + 1j * vals.imag).astype(dt)
+        return flipped.astype(dt)
+
+    # ---- summaries ---------------------------------------------------
+    def fired(self) -> int:
+        """Number of corruption events applied so far."""
+        return len(self.log)
+
+    def summary(self) -> list:
+        return [{"target": ev.target, "call": ev.call, "output": ev.output,
+                 "kind": ev.kind, "nelem": int(ev.indices.size)}
+                for ev in self.log]
+
+
+def logs_identical(a: FaultPlan, b: FaultPlan) -> bool:
+    """Bit-exact comparison of two plans' corruption logs (the
+    determinism oracle: same seed + same run => identical)."""
+    if len(a.log) != len(b.log):
+        return False
+    for ea, eb in zip(a.log, b.log):
+        if (ea.target, ea.call, ea.output, ea.kind, ea.shape, ea.dtype) \
+                != (eb.target, eb.call, eb.output, eb.kind, eb.shape,
+                    eb.dtype):
+            return False
+        if not np.array_equal(ea.indices, eb.indices):
+            return False
+        if ea.before.tobytes() != eb.before.tobytes() \
+                or ea.after.tobytes() != eb.after.tobytes():
+            return False
+    return True

@@ -29,6 +29,14 @@ one of ``hit`` / ``miss`` / ``unparsable`` / ``stale_schema`` /
 ``key_mismatch`` (writes count as ``write``), and :func:`scan` reports
 per-file validity -- ``python -m perf.tune show`` surfaces both, so a
 silently rejected stale cache is no longer invisible.
+
+Unwritable directories (ISSUE 7): a read-only filesystem or a bad
+``$ELEMENTAL_TPU_TUNE_CACHE`` must never fail a solve -- ``'auto'``
+resolution can trigger a measured-winner write MID-DRIVER.  :func:`save`
+therefore degrades gracefully: on any ``OSError`` it warns ONCE per
+directory (``RuntimeWarning``) and falls back to an in-process memory
+cache, which :func:`load` consults after a file miss; the outcomes are
+counted as ``write_fallback`` / ``mem_hit`` events.
 """
 from __future__ import annotations
 
@@ -37,6 +45,7 @@ import json
 import os
 import tempfile
 import time
+import warnings
 
 from ..obs import metrics as _metrics
 
@@ -81,9 +90,32 @@ def make_key(op: str, dims, dtype: str, grid_shape, backend: str) -> CacheKey:
                     grid_shape=tuple(grid_shape), backend=str(backend))
 
 
+#: in-process fallback entries (keyed by filename) for sessions whose
+#: cache directory is unwritable; loads consult it after a file miss
+_MEM_FALLBACK: dict = {}
+
+#: directories already warned about (warn ONCE per dir per process)
+_WARNED_DIRS: set = set()
+
+
+def _warn_unwritable(d: str, exc: OSError) -> None:
+    if d in _WARNED_DIRS:
+        return
+    _WARNED_DIRS.add(d)
+    warnings.warn(
+        f"elemental_tpu tuning cache directory {d!r} is not writable "
+        f"({exc!s}); falling back to an in-process memory cache for this "
+        f"session (set ${ENV_DIR} to a writable path to persist winners)",
+        RuntimeWarning, stacklevel=3)
+
+
 def save(key: CacheKey, config: dict, source: str = "measured",
          metric: dict | None = None) -> str:
-    """Atomically persist a winner config for ``key``; returns the path."""
+    """Atomically persist a winner config for ``key``; returns the path.
+
+    NEVER raises on an unwritable directory: the entry falls back to the
+    in-process memory cache (warn-once + ``write_fallback`` event) so a
+    mid-solve measured-winner write cannot take the solve down."""
     doc = {"schema": SCHEMA, "op": key.op, "bucket": list(key.bucket),
            "dtype": key.dtype, "grid": list(key.grid_shape),
            "backend": key.backend, "config": dict(config), "source": source,
@@ -91,14 +123,29 @@ def save(key: CacheKey, config: dict, source: str = "measured",
     if metric:
         doc["metric"] = dict(metric)
     d = cache_dir()
-    os.makedirs(d, exist_ok=True)
     path = key.path()
-    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tune_", suffix=".tmp")
+    try:
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".tune_", suffix=".tmp")
+    except OSError as exc:
+        _warn_unwritable(d, exc)
+        _MEM_FALLBACK[key.filename()] = doc
+        _metrics.inc("tune_cache_events", op=key.op, event="write_fallback")
+        return path
     try:
         with os.fdopen(fd, "w") as f:
             json.dump(doc, f, indent=1, sort_keys=False)
             f.write("\n")
         os.replace(tmp, path)            # atomic on POSIX
+    except OSError as exc:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        _warn_unwritable(d, exc)
+        _MEM_FALLBACK[key.filename()] = doc
+        _metrics.inc("tune_cache_events", op=key.op, event="write_fallback")
+        return path
     except BaseException:
         try:
             os.unlink(tmp)
@@ -122,6 +169,10 @@ def load(key: CacheKey) -> dict | None:
         with open(path) as f:
             doc = json.load(f)
     except OSError:
+        mem = _MEM_FALLBACK.get(key.filename())
+        if mem is not None:
+            _metrics.inc("tune_cache_events", op=key.op, event="mem_hit")
+            return mem
         _metrics.inc("tune_cache_events", op=key.op, event="miss")
         return None
     except ValueError:
@@ -183,7 +234,11 @@ def entries() -> list:
 
 
 def clear(op: str | None = None) -> int:
-    """Delete cache entries (all, or only those of ``op``); returns count."""
+    """Delete cache entries (all, or only those of ``op``); returns count.
+    In-process fallback entries (unwritable-dir sessions) clear too."""
+    for name in [n for n in _MEM_FALLBACK
+                 if op is None or n.startswith(f"{op}__")]:
+        del _MEM_FALLBACK[name]
     d = cache_dir()
     removed = 0
     try:

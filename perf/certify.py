@@ -1,0 +1,209 @@
+"""Certified-solve CLI (ISSUE 7): run a residual-certified solve, print
+the certificate; optionally under deterministic fault injection.
+
+The command-line face of ``elemental_tpu/resilience``:
+
+    python -m perf.certify run lu 256 --grid 2x2
+                                            # certified_solve('lu', ...):
+                                            #   one solve_certificate/v1
+                                            #   line on stdout, human
+                                            #   summary rows # -prefixed
+    python -m perf.certify run hpd --n 128 --tol 1e-12 --nb 32
+    python -m perf.certify run lu --fault redistribute:nan:2 --seed 7
+                                            # corrupt the 3rd redistribute
+                                            #   payload; watch the ladder
+                                            #   escalate (add ':every' to
+                                            #   corrupt every call onward)
+    python -m perf.certify smoke            # the tools/check.sh gate:
+                                            #   clean certification on 1x1
+                                            #   AND 2x2 grids for lu+hpd,
+                                            #   plus one injected-fault
+                                            #   escalation; exit 1 on any
+                                            #   silent-garbage outcome
+
+``--fault`` is ``target:kind:call[:every]`` with target one of
+``redistribute`` / ``panel_spread`` and kind one of ``bitflip`` /
+``scale`` / ``nan`` (see ``resilience.faults``).  Runs are CPU-safe: the
+same virtual 8-device host mesh as ``perf.trace``.
+
+Flags for ``run``: ``--n N`` (or positional; default 128), ``--nb NB``,
+``--grid RxC`` (default 2x2 when >= 4 devices), ``--dtype NAME``,
+``--tol X``, ``--seed S`` (fault plan seed, default 0), ``--fault SPEC``
+(repeatable), ``--health/--no-health``, ``--json`` (certificate only,
+no summary rows).
+"""
+import json
+import sys
+
+from .trace import _bootstrap, _grid
+
+
+def _build(op, n, dtype, grid):
+    import numpy as np
+    import elemental_tpu as el
+    rng = np.random.default_rng(0)
+    F = rng.normal(size=(n, n)).astype(dtype)
+    if op == "hpd":
+        Fh = (F @ F.T / n + n * np.eye(n)).astype(dtype)
+    else:
+        Fh = (F + n * np.eye(n, dtype=dtype))
+    B = rng.normal(size=(n, max(1, min(4, n)))).astype(dtype)
+    A = el.from_global(Fh, el.MC, el.MR, grid=grid)
+    Bd = el.from_global(B, el.MC, el.MR, grid=grid)
+    return A, Bd
+
+
+def _parse_fault(spec: str):
+    from elemental_tpu.resilience import FaultSpec
+    parts = spec.split(":")
+    if len(parts) < 2:
+        raise SystemExit(f"--fault needs target:kind[:call[:every]], "
+                         f"got {spec!r}")
+    target, kind = parts[0], parts[1]
+    call = int(parts[2]) if len(parts) > 2 else 0
+    every = len(parts) > 3 and parts[3] == "every"
+    return FaultSpec(target=target, kind=kind, call=call, every=every)
+
+
+def _run_one(op, n, nb, grid, dtype, tol, faults, seed, health):
+    """One certified solve; returns (info, plan-or-None)."""
+    from elemental_tpu.resilience import (FaultPlan, certified_solve,
+                                          fault_injection)
+    A, B = _build(op, n, dtype, grid)
+    if faults:
+        plan = FaultPlan(seed=seed, faults=faults)
+        with fault_injection(plan):
+            _, info = certified_solve(op, A, B, tol=tol, nb=nb,
+                                      health=health)
+        return info, plan
+    _, info = certified_solve(op, A, B, tol=tol, nb=nb, health=health)
+    return info, None
+
+
+def cmd_run(op, n, nb, grid_spec, dtype, tol, faults, seed, health,
+            as_json) -> int:
+    grid = _grid(grid_spec)
+    info, plan = _run_one(op, n, nb, grid, dtype, tol, faults, seed, health)
+    if not as_json:
+        print(f"# certify {op} n={n} grid={grid.height}x{grid.width} "
+              f"tol={info['tol']:.3e}")
+        for att in info["attempts"]:
+            res = att["residual"]
+            print(f"#   rung={att['rung']:8s} residual="
+                  f"{'nan' if res is None else format(res, '.3e')} "
+                  f"refine={att['refine_iters']} "
+                  f"singular={att['singular']}")
+        if plan is not None:
+            print(f"# faults fired: {plan.fired()} "
+                  f"({json.dumps(plan.summary())})")
+        verdict = (f"CERTIFIED at rung {info['rung']!r}" if info["certified"]
+                   else f"NOT certified (failing phase: "
+                        f"{info['failing_phase']})")
+        print(f"# {verdict}")
+    print(json.dumps(info))
+    return 0 if info["certified"] or info["failing_phase"] is not None else 1
+
+
+def cmd_smoke() -> int:
+    """The check.sh gate: clean certification on 1x1 and 2x2 for both ops
+    + one injected persistent-NaN run that must be repaired or surfaced
+    (never silent).  Small n, CPU-safe, exit 1 on any violation."""
+    from elemental_tpu.resilience import FaultSpec
+    rc = 0
+    n, nb = 32, 8
+    for spec in ("1x1", "2x2"):
+        grid = _grid(spec)
+        for op in ("lu", "hpd"):
+            info, _ = _run_one(op, n, nb, grid, "float32", None, (), 0, True)
+            ok = info["certified"]
+            print(f"# smoke {op} {spec}: certified={ok} "
+                  f"rung={info['rung']} residual={info['residual']}")
+            if not ok:
+                rc = 1
+    # injected fault on the 2x2 grid: escalation must repair it (one-shot)
+    grid = _grid("2x2")
+    info, plan = _run_one("hpd", n, nb, grid, "float32", None,
+                          (FaultSpec("panel_spread", "nan", call=0),), 0,
+                          True)
+    print(f"# smoke fault(one-shot nan): certified={info['certified']} "
+          f"rung={info['rung']} fired={plan.fired()}")
+    if not (plan.fired() and info["certified"]):
+        rc = 1
+    # persistent corruption: must be SURFACED, never silently certified
+    info, plan = _run_one("lu", n, nb, grid, "float32", None,
+                          (FaultSpec("redistribute", "nan", call=1,
+                                     every=True),), 0, True)
+    surfaced = (not info["certified"]) and info["failing_phase"] is not None
+    print(f"# smoke fault(persistent nan): surfaced={surfaced} "
+          f"failing_phase={info['failing_phase']} fired={plan.fired()}")
+    if not (plan.fired() and surfaced):
+        rc = 1
+    print("# certify smoke:", "ok" if rc == 0 else "FAILED")
+    return rc
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    cmd = argv.pop(0)
+    if cmd not in ("run", "smoke"):
+        print(__doc__)
+        raise SystemExit(f"unknown command {cmd!r}")
+    if cmd == "smoke":
+        _bootstrap()
+        return cmd_smoke()
+    pos = []
+    n = nb = tol = None
+    grid_spec = None
+    dtype, seed, health, as_json = "float32", 0, True, False
+    faults = []
+    it = iter(argv)
+    for arg in it:
+        if arg == "--n":
+            n = int(next(it))
+        elif arg == "--nb":
+            nb = int(next(it))
+        elif arg == "--grid":
+            grid_spec = next(it)
+        elif arg == "--dtype":
+            dtype = next(it)
+        elif arg == "--tol":
+            tol = float(next(it))
+        elif arg == "--seed":
+            seed = int(next(it))
+        elif arg == "--fault":
+            faults.append(next(it))
+        elif arg == "--health":
+            health = True
+        elif arg == "--no-health":
+            health = False
+        elif arg == "--json":
+            as_json = True
+        elif arg.startswith("--"):
+            raise SystemExit(f"unknown flag {arg!r}")
+        else:
+            pos.append(arg)
+    if not pos:
+        raise SystemExit("run needs an op (lu/hpd)")
+    op = pos.pop(0)
+    if op == "cholesky":
+        op = "hpd"
+    if op not in ("lu", "hpd"):
+        print("unknown op; registered ops:", file=sys.stderr)
+        for o in ("lu", "hpd"):
+            print(f"  {o}", file=sys.stderr)
+        return 1
+    if pos and n is None:
+        n = int(pos.pop(0))
+    if n is None:
+        n = 128
+    _bootstrap()
+    fspecs = tuple(_parse_fault(s) for s in faults)
+    return cmd_run(op, n, nb, grid_spec, dtype, tol, fspecs, seed, health,
+                   as_json)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

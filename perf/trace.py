@@ -233,6 +233,14 @@ def main(argv=None) -> int:
         if not pos:
             raise SystemExit(f"run needs a driver ({'/'.join(DRIVERS)})")
         driver = pos.pop(0)
+        if driver not in DRIVERS:
+            # before _bootstrap: no jax import, no device init, no
+            # input-building -- just the registry and a clean exit 1
+            print(f"unknown driver {driver!r}; registered drivers:",
+                  file=sys.stderr)
+            for d in DRIVERS:
+                print(f"  {d}", file=sys.stderr)
+            return 1
         if pos and n is None:
             n = int(pos.pop(0))
         _bootstrap()

@@ -1,0 +1,191 @@
+"""Certified solves (ISSUE 7): clean certification, certificate schema,
+ladder pinning, tolerance semantics, singular escalation."""
+import numpy as np
+import pytest
+
+import elemental_tpu as el
+from elemental_tpu import MC, MR, from_global, to_global
+from elemental_tpu.resilience import (CERT_SCHEMA, LADDER_NAMES, Rung,
+                                      certified_solve, default_ladder,
+                                      default_tol)
+
+
+def _dist(g, arr):
+    return from_global(arr, MC, MR, grid=g)
+
+
+def _problem(rng, n, nrhs=3, op="lu"):
+    F = rng.normal(size=(n, n))
+    A = F @ F.T / n + n * np.eye(n) if op == "hpd" else F + n * np.eye(n)
+    B = rng.normal(size=(n, nrhs))
+    return A, B
+
+
+def _clean_resid(An, Bn, X):
+    Xn = np.asarray(to_global(X), dtype=np.float64)
+    return np.linalg.norm(Bn - An @ Xn) / (
+        np.linalg.norm(An) * np.linalg.norm(Xn) + np.linalg.norm(Bn))
+
+
+# ---------------------------------------------------------------------
+# the ladder itself is pinned: refine -> fp32 -> classic panel
+# ---------------------------------------------------------------------
+
+def test_ladder_order_pinned():
+    assert LADDER_NAMES == ("fast", "refine", "fp32", "classic")
+    for op in ("lu", "hpd"):
+        rungs = default_ladder(op)
+        assert tuple(r.name for r in rungs) == LADDER_NAMES
+        # 'refine' escalates WITHOUT refactorization; the rest refactor
+        assert [r.refactor for r in rungs] == [True, False, True, True]
+    # rung configs speak the tuner's knob vocabulary (ISSUE 4/6 reuse)
+    from elemental_tpu.tune.knobs import LU_PANELS, OPS
+    lu_rungs = default_ladder("lu")
+    assert lu_rungs[0].config["panel"] == LU_PANELS[1]      # calu
+    assert lu_rungs[-1].config["panel"] == LU_PANELS[0]     # classic
+    tunable = set(OPS["lu"].knobs)
+    for r in lu_rungs:
+        assert set(r.config) <= tunable | {"update_precision", "precision",
+                                           "lookahead"}
+
+
+# ---------------------------------------------------------------------
+# clean problems certify at the fast rung, on 1x1 and 2x2 grids
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("op", ["lu", "hpd"])
+def test_clean_certifies_fast_2x2(grid24, op):
+    rng = np.random.default_rng(91)
+    An, Bn = _problem(rng, 24, op=op)
+    X, info = certified_solve(op, _dist(grid24, An), _dist(grid24, Bn), nb=8)
+    assert info["certified"] is True
+    assert info["rung"] == "fast"
+    assert info["residual"] <= info["tol"]
+    assert info["failing_phase"] is None
+    assert _clean_resid(An, Bn, X) <= info["tol"]
+    assert np.isfinite(np.asarray(to_global(X))).all()
+
+
+@pytest.mark.parametrize("op", ["lu", "hpd"])
+def test_clean_certifies_1x1(op):
+    import jax
+    g1 = el.Grid([jax.devices()[0]])
+    rng = np.random.default_rng(92)
+    An, Bn = _problem(rng, 20, op=op)
+    X, info = certified_solve(op, _dist(g1, An), _dist(g1, Bn), nb=8)
+    assert info["certified"] is True and info["rung"] == "fast"
+
+
+def test_certificate_schema_pin(grid24):
+    rng = np.random.default_rng(93)
+    An, Bn = _problem(rng, 16)
+    _, info = certified_solve("lu", _dist(grid24, An), _dist(grid24, Bn),
+                              nb=8)
+    assert info["schema"] == CERT_SCHEMA
+    assert set(info) == {"schema", "op", "certified", "rung", "residual",
+                         "tol", "refine_iters", "ladder", "attempts",
+                         "singular", "failing_phase", "health"}
+    assert info["ladder"] == list(LADDER_NAMES)
+    att = info["attempts"][0]
+    assert set(att) == {"rung", "residual", "refine_iters", "singular",
+                        "diag_index", "health"}
+    assert att["health"]["schema"] == "health_report/v1"
+    assert info["tol"] == pytest.approx(default_tol(16, np.float64))
+
+
+# ---------------------------------------------------------------------
+# failure semantics: impossible tolerance, singular input
+# ---------------------------------------------------------------------
+
+def test_impossible_tol_exhausts_ladder(grid24):
+    """tol=0 can never certify: the ladder runs every rung (refinement
+    stalls and escalates) and the failure names 'residual' -- the
+    measurement, not a health flag -- as the failing phase."""
+    rng = np.random.default_rng(94)
+    An, Bn = _problem(rng, 16)
+    X, info = certified_solve("lu", _dist(grid24, An), _dist(grid24, Bn),
+                              nb=8, tol=0.0)
+    assert info["certified"] is False
+    assert info["rung"] is None
+    assert [a["rung"] for a in info["attempts"]] == list(LADDER_NAMES)
+    assert info["failing_phase"] == "residual"
+    assert info["singular"] is False
+    # the solution is still returned (and is actually fine)
+    assert _clean_resid(An, Bn, X) < 1e-12
+
+
+def test_singular_input_structured_failure(grid24):
+    rng = np.random.default_rng(95)
+    F = rng.normal(size=(16, 16))
+    F[11] = F[4]                         # exactly singular
+    B = rng.normal(size=(16, 2))
+    X, info = certified_solve("lu", _dist(grid24, F), _dist(grid24, B), nb=8)
+    assert info["certified"] is False
+    assert info["singular"] is True      # EVERY rung's factor was singular
+    assert info["failing_phase"] in ("diag", "panel")
+    assert all(a["singular"] for a in info["attempts"])
+    assert all(a["diag_index"] is not None for a in info["attempts"])
+    assert X is None                     # no non-singular factor existed
+
+
+def test_custom_ladder_and_tol(grid24):
+    """Explicit ladder + tol are honored; a single classic rung works."""
+    rng = np.random.default_rng(96)
+    An, Bn = _problem(rng, 16)
+    ladder = (Rung("classic", {"panel": "classic",
+                               "update_precision": None}, refine=2),)
+    X, info = certified_solve("lu", _dist(grid24, An), _dist(grid24, Bn),
+                              nb=8, ladder=ladder, tol=1e-10)
+    assert info["certified"] is True
+    assert info["rung"] == "classic"
+    assert info["ladder"] == ["classic"]
+    assert info["tol"] == 1e-10
+
+
+# ---------------------------------------------------------------------
+# the structured singular signal on the plain solve drivers
+# ---------------------------------------------------------------------
+
+def test_lu_solve_info_singular_pinned(grid24):
+    rng = np.random.default_rng(97)
+    F = rng.normal(size=(16, 16))
+    F[9] = F[2]
+    B = rng.normal(size=(16, 2))
+    X, inf = el.lu_solve(_dist(grid24, F), _dist(grid24, B), nb=8, info=True)
+    assert inf["singular"] is True
+    # the zero pivot of a rank-(n-1) matrix lands on the LAST diagonal
+    assert inf["diag_index"] == 15
+    assert inf["finite"] is True         # the FACTOR is finite; X is not
+    # and the well-posed sibling is clean
+    F2 = rng.normal(size=(16, 16)) + 16 * np.eye(16)
+    X2, inf2 = el.lu_solve(_dist(grid24, F2), _dist(grid24, B), nb=8,
+                           info=True)
+    assert inf2 == {"singular": False, "diag_index": None, "finite": True}
+    assert np.isfinite(np.asarray(to_global(X2))).all()
+
+
+def test_hpd_solve_info_singular(grid24):
+    rng = np.random.default_rng(98)
+    v = rng.normal(size=(16, 2))
+    S = v @ v.T                          # rank-2 PSD: not PD
+    B = rng.normal(size=(16, 2))
+    X, inf = el.hpd_solve(_dist(grid24, S), _dist(grid24, B), nb=8,
+                          info=True)
+    assert inf["singular"] is True
+    assert inf["diag_index"] is not None
+    Sg = v @ v.T + 16 * np.eye(16)
+    X2, inf2 = el.hpd_solve(_dist(grid24, Sg), _dist(grid24, B), nb=8,
+                            info=True)
+    assert inf2["singular"] is False and inf2["finite"] is True
+
+
+def test_solve_info_default_unchanged(grid24):
+    """info defaults off: the historical single-return contract holds."""
+    rng = np.random.default_rng(99)
+    An, Bn = _problem(rng, 16)
+    X = el.lu_solve(_dist(grid24, An), _dist(grid24, Bn), nb=8)
+    from elemental_tpu.core.distmatrix import DistMatrix
+    assert isinstance(X, DistMatrix)
+    Sn, _ = _problem(rng, 16, op="hpd")
+    X2 = el.hpd_solve(_dist(grid24, Sn), _dist(grid24, Bn), nb=8)
+    assert isinstance(X2, DistMatrix)

@@ -1,0 +1,212 @@
+"""Deterministic fault injection (ISSUE 7): seed determinism, the
+corruption-class x target acceptance matrix, ladder escalation pinning,
+and the no-silent-garbage invariant."""
+import numpy as np
+import pytest
+
+import elemental_tpu as el
+from elemental_tpu import MC, MR, from_global, to_global
+from elemental_tpu.resilience import (FaultPlan, FaultSpec, certified_solve,
+                                      fault_injection, logs_identical)
+
+
+def _dist(g, arr):
+    return from_global(arr, MC, MR, grid=g)
+
+
+def _problem(rng, n, op, nrhs=2):
+    F = rng.normal(size=(n, n))
+    A = F @ F.T / n + n * np.eye(n) if op == "hpd" else F + n * np.eye(n)
+    B = rng.normal(size=(n, nrhs))
+    return A, B
+
+
+def _clean_resid(An, Bn, X):
+    Xn = np.asarray(to_global(X), dtype=np.float64)
+    return np.linalg.norm(Bn - An @ Xn) / (
+        np.linalg.norm(An) * np.linalg.norm(Xn) + np.linalg.norm(Bn))
+
+
+# the op whose solve path exercises each engine target: lu routes through
+# redistribute; the cholesky trailing chain is THE panel_spread caller
+_OP_FOR_TARGET = {"redistribute": "lu", "panel_spread": "hpd"}
+
+
+# ---------------------------------------------------------------------
+# plan mechanics
+# ---------------------------------------------------------------------
+
+def test_spec_validation():
+    with pytest.raises(ValueError):
+        FaultSpec("bogus_target", "nan")
+    with pytest.raises(ValueError):
+        FaultSpec("redistribute", "bogus_kind")
+    with pytest.raises(ValueError):
+        FaultSpec("redistribute", "nan", call=-1)
+    with pytest.raises(TypeError):
+        FaultPlan(0, ["not a spec"])
+
+
+def test_injection_scoped_and_counted(grid24):
+    """Corruption happens only inside the context manager, on exactly the
+    requested call, and the log records the bit-level change."""
+    rng = np.random.default_rng(101)
+    F = rng.normal(size=(16, 16)) + 16 * np.eye(16)
+    A = _dist(grid24, F)
+    plan = FaultPlan(seed=3, faults=[FaultSpec("redistribute", "nan",
+                                               call=0, nelem=2)])
+    LU0, _ = el.lu(A, nb=8)                        # outside: untouched
+    with fault_injection(plan):
+        LU1, _ = el.lu(A, nb=8)
+    LU2, _ = el.lu(A, nb=8)                        # after: untouched again
+    assert plan.fired() == 1
+    ev = plan.log[0]
+    assert ev.target == "redistribute" and ev.call == 0 and ev.kind == "nan"
+    assert ev.indices.size == 2
+    assert np.isnan(ev.after).all() and np.isfinite(ev.before).all()
+    assert not np.isfinite(np.asarray(to_global(LU1))).all()
+    assert np.isfinite(np.asarray(to_global(LU0))).all()
+    np.testing.assert_array_equal(np.asarray(to_global(LU0)),
+                                  np.asarray(to_global(LU2)))
+
+
+@pytest.mark.parametrize("kind", ["bitflip", "scale", "nan"])
+def test_corruption_kinds_change_payload(grid24, kind):
+    rng = np.random.default_rng(102)
+    F = rng.normal(size=(16, 16)) + 16 * np.eye(16)
+    plan = FaultPlan(seed=11, faults=[FaultSpec("redistribute", kind,
+                                                call=1, nelem=3)])
+    with fault_injection(plan):
+        el.lu(_dist(grid24, F), nb=8)
+    assert plan.fired() == 1
+    ev = plan.log[0]
+    assert ev.kind == kind
+    assert not np.array_equal(ev.before, ev.after)
+    if kind == "nan":
+        assert np.isnan(ev.after).all()
+    if kind == "scale":
+        np.testing.assert_allclose(ev.after, ev.before * 1e12)
+
+
+# ---------------------------------------------------------------------
+# SATELLITE: determinism -- identical seed => bit-identical corrupted
+# payloads AND identical escalation ladder outcome across two runs
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("target", ["redistribute", "panel_spread"])
+def test_fault_determinism_two_runs(grid24, target):
+    op = _OP_FOR_TARGET[target]
+    rng = np.random.default_rng(103)
+    An, Bn = _problem(rng, 24, op)
+    A, B = _dist(grid24, An), _dist(grid24, Bn)
+
+    def run(plan):
+        with fault_injection(plan):
+            X, info = certified_solve(op, A, B, nb=8)
+        return X, info
+
+    mk = lambda: FaultPlan(seed=42, faults=[
+        FaultSpec(target, "scale", call=0),
+        FaultSpec(target, "bitflip", call=2, nelem=2)])
+    p1, p2 = mk(), mk()
+    X1, i1 = run(p1)
+    X2, i2 = run(p2)
+    assert p1.fired() > 0
+    assert logs_identical(p1, p2)                 # bit-identical payloads
+    # identical ladder outcome
+    assert i1["certified"] == i2["certified"]
+    assert i1["rung"] == i2["rung"]
+    assert [a["rung"] for a in i1["attempts"]] \
+        == [a["rung"] for a in i2["attempts"]]
+    assert [a["refine_iters"] for a in i1["attempts"]] \
+        == [a["refine_iters"] for a in i2["attempts"]]
+    if X1 is not None:
+        np.testing.assert_array_equal(np.asarray(to_global(X1)),
+                                      np.asarray(to_global(X2)))
+    # the SAME plan object replays after reset()
+    p1.reset()
+    _, i3 = run(p1)
+    assert logs_identical(p1, p2) and i3["rung"] == i1["rung"]
+
+
+def test_different_seed_different_payload(grid24):
+    rng = np.random.default_rng(104)
+    F = rng.normal(size=(16, 16)) + 16 * np.eye(16)
+    logs = []
+    for seed in (1, 2):
+        plan = FaultPlan(seed=seed, faults=[FaultSpec(
+            "redistribute", "bitflip", call=0, nelem=4)])
+        with fault_injection(plan):
+            el.lu(_dist(grid24, F), nb=8)
+        logs.append(plan)
+    ea, eb = logs[0].log[0], logs[1].log[0]
+    assert not (np.array_equal(ea.indices, eb.indices)
+                and ea.after.tobytes() == eb.after.tobytes())
+
+
+# ---------------------------------------------------------------------
+# ACCEPTANCE MATRIX: every corruption class x target on a 2x2 grid --
+# certified within tolerance after escalation, or a structured health
+# report naming the failing phase.  ZERO silent NaN/garbage returns.
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("kind", ["bitflip", "scale", "nan"])
+@pytest.mark.parametrize("target", ["redistribute", "panel_spread"])
+@pytest.mark.parametrize("mode", ["oneshot", "persistent"])
+def test_fault_matrix_no_silent_garbage(grid24, target, kind, mode):
+    op = _OP_FOR_TARGET[target]
+    rng = np.random.default_rng(105)
+    An, Bn = _problem(rng, 24, op)
+    A, B = _dist(grid24, An), _dist(grid24, Bn)
+    spec = FaultSpec(target, kind, call=0 if target == "panel_spread" else 2,
+                     every=(mode == "persistent"), nelem=2)
+    plan = FaultPlan(seed=13, faults=[spec])
+    with fault_injection(plan):
+        X, info = certified_solve(op, A, B, nb=8)
+    assert plan.fired() > 0, "fault never landed: the matrix is vacuous"
+    if info["certified"]:
+        # certificate must be INDEPENDENTLY true (clean-path residual)
+        assert X is not None
+        assert np.isfinite(np.asarray(to_global(X))).all()
+        assert _clean_resid(An, Bn, X) <= info["tol"]
+    else:
+        # structured failure: the report names the failing phase
+        assert info["failing_phase"] is not None
+        assert info["attempts"], "no attempts recorded"
+
+
+def test_oneshot_fault_escalation_order_pinned(grid24):
+    """A one-shot NaN on the first panel_spread corrupts rung 'fast''s
+    factor; 'refine' (same factor) cannot fix it; 'fp32' refactors
+    cleanly and certifies -- the ladder order refine -> fp32 pinned."""
+    rng = np.random.default_rng(106)
+    An, Bn = _problem(rng, 24, "hpd")
+    plan = FaultPlan(seed=5, faults=[FaultSpec("panel_spread", "nan",
+                                               call=0)])
+    with fault_injection(plan):
+        X, info = certified_solve("hpd", _dist(grid24, An),
+                                  _dist(grid24, Bn), nb=8)
+    assert info["certified"] is True
+    assert info["rung"] == "fp32"
+    assert [a["rung"] for a in info["attempts"]] == ["fast", "refine",
+                                                     "fp32"]
+    assert _clean_resid(An, Bn, X) <= info["tol"]
+    # the corrupted attempts carry their health evidence
+    assert info["attempts"][0]["health"]["ok"] is False
+
+
+def test_persistent_corruption_surfaced_with_phase(grid24):
+    """every=True NaN corruption can never certify; the certificate names
+    the failing phase from the health reports."""
+    rng = np.random.default_rng(107)
+    An, Bn = _problem(rng, 24, "lu")
+    plan = FaultPlan(seed=5, faults=[FaultSpec("redistribute", "nan",
+                                               call=1, every=True)])
+    with fault_injection(plan):
+        X, info = certified_solve("lu", _dist(grid24, An),
+                                  _dist(grid24, Bn), nb=8)
+    assert info["certified"] is False
+    assert info["failing_phase"] is not None
+    assert info["health"] is not None
+    assert [a["rung"] for a in info["attempts"]] \
+        == ["fast", "refine", "fp32", "classic"]

@@ -1,0 +1,201 @@
+"""Numerical-health guards (ISSUE 7): flag detection, schema pin,
+metrics/tracer emission, and the health-off zero-overhead invariant."""
+import numpy as np
+import pytest
+
+import elemental_tpu as el
+from elemental_tpu import MC, MR, from_global
+from elemental_tpu.obs import Tracer, metrics_scope
+from elemental_tpu.resilience import (HEALTH_SCHEMA, HealthMonitor,
+                                      last_health_report)
+
+
+def _dist(g, arr):
+    return from_global(arr, MC, MR, grid=g)
+
+
+def _spd(rng, n):
+    F = rng.normal(size=(n, n))
+    return F @ F.T / n + n * np.eye(n)
+
+
+# ---------------------------------------------------------------------
+# clean runs: ok reports, sane estimates
+# ---------------------------------------------------------------------
+
+def test_clean_lu_report_ok(grid24):
+    rng = np.random.default_rng(71)
+    F = rng.normal(size=(24, 24)) + 24 * np.eye(24)
+    mon = HealthMonitor()
+    el.lu(_dist(grid24, F), nb=8, health=mon)
+    rep = mon.report()
+    assert rep["schema"] == HEALTH_SCHEMA
+    assert rep["ok"] is True
+    assert rep["flags"] == []
+    assert rep["failing_phase"] is None
+    assert rep["checks"] > 0
+    # diagonally dominant matrix: no meaningful growth
+    assert rep["growth_estimate"] is not None
+    assert 0.5 < rep["growth_estimate"] < 100.0
+    assert rep["scale"] == pytest.approx(np.max(np.abs(F)))
+
+
+def test_clean_cholesky_report_ok(grid24):
+    rng = np.random.default_rng(72)
+    mon = HealthMonitor()
+    el.cholesky(_dist(grid24, _spd(rng, 24)), nb=8, health=mon)
+    rep = mon.report()
+    assert rep["ok"] is True and rep["driver"] == "cholesky"
+    assert rep["min_diag"] is not None and rep["min_diag"] > 0
+
+
+def test_report_schema_pin(grid24):
+    """health_report/v1 key set is stable (consumers parse it)."""
+    rng = np.random.default_rng(73)
+    mon = HealthMonitor()
+    el.lu(_dist(grid24, rng.normal(size=(16, 16))), nb=8, health=mon)
+    rep = mon.report()
+    assert set(rep) == {"schema", "driver", "ok", "checks", "flags",
+                        "growth_estimate", "scale", "min_diag",
+                        "failing_phase"}
+
+
+# ---------------------------------------------------------------------
+# flag detection
+# ---------------------------------------------------------------------
+
+def test_nan_input_flags_nonfinite(grid24):
+    rng = np.random.default_rng(74)
+    F = rng.normal(size=(24, 24))
+    F[5, 7] = np.nan
+    mon = HealthMonitor()
+    el.lu(_dist(grid24, F), nb=8, health=mon)
+    rep = mon.report()
+    assert rep["ok"] is False
+    kinds = {f["kind"] for f in rep["flags"]}
+    assert "nonfinite" in kinds
+    assert rep["failing_phase"] in ("panel", "swap", "solve", "update",
+                                    "tail", "tournament")
+
+
+def test_cholesky_nonpd_flagged(grid24):
+    """A non-PD input NaNs out of the diag-block cholesky; the guard
+    surfaces it instead of letting the NaN factor flow downstream."""
+    n = 16
+    A = -np.eye(n)
+    mon = HealthMonitor()
+    el.cholesky(_dist(grid24, A), nb=8, health=mon)
+    rep = mon.report()
+    assert rep["ok"] is False
+    kinds = {f["kind"] for f in rep["flags"]}
+    assert kinds & {"nonfinite", "nonpositive_diag"}
+
+
+def test_lu_small_pivot_flagged(grid24):
+    """An exactly-singular matrix surfaces a (near-)zero pivot flag on a
+    panel tick."""
+    rng = np.random.default_rng(75)
+    F = rng.normal(size=(16, 16))
+    F[9] = F[2]                          # duplicate row: exact singularity
+    mon = HealthMonitor()
+    # crossover=0: the final panel (where the zero pivot lands) must run
+    # in the distributed loop so its packed factor hits a panel tick
+    el.lu(_dist(grid24, F), nb=8, crossover=0, health=mon)
+    rep = mon.report()
+    assert rep["ok"] is False
+    assert any(f["kind"] == "small_pivot" for f in rep["flags"])
+
+
+def test_growth_flag_on_blowup(grid24):
+    """A huge injected blowup in the input trips the growth estimate --
+    the monitor's anchor is max |A|, so scale the BLOWUP mid-run via a
+    tiny growth_limit instead (the estimate itself is what's pinned)."""
+    rng = np.random.default_rng(76)
+    F = rng.normal(size=(16, 16))
+    mon = HealthMonitor(growth_limit=1e-3)   # everything trips
+    el.lu(_dist(grid24, F), nb=8, health=mon)
+    rep = mon.report()
+    assert any(f["kind"] == "growth" for f in rep["flags"])
+    assert rep["growth_estimate"] > 1e-3
+
+
+# ---------------------------------------------------------------------
+# emission: metrics registry, tracer instants, last_health_report
+# ---------------------------------------------------------------------
+
+def test_metrics_and_last_report(grid24):
+    rng = np.random.default_rng(77)
+    F = rng.normal(size=(16, 16))
+    F[3, 3] = np.inf
+    with metrics_scope() as reg:
+        el.lu(_dist(grid24, F), nb=8, health=True)   # internal monitor
+        assert reg.counter_value("health_checks", driver="lu") > 0
+        flags = reg.counters("health_flags")
+        assert flags and all(k[0] == "health_flags" for k in flags)
+    rep = last_health_report("lu")
+    assert rep is not None and rep["ok"] is False
+    assert last_health_report() is not None
+
+
+def test_tracer_instant_events(grid24):
+    rng = np.random.default_rng(78)
+    F = rng.normal(size=(16, 16))
+    F[2, 5] = np.nan
+    tr = Tracer()
+    with tr:
+        el.lu(_dist(grid24, F), nb=8, health=True)
+    names = [ev.name for ev in tr.instants]
+    assert any(nm.startswith("health:") for nm in names)
+    from elemental_tpu.obs import chrome_trace_doc
+    doc = chrome_trace_doc(tr)
+    evs = [ev for ev in doc["traceEvents"]
+           if ev.get("ph") == "i" and ev["name"].startswith("health:")]
+    assert evs
+    lanes = {ev["tid"]: ev["args"]["name"] for ev in doc["traceEvents"]
+             if ev.get("ph") == "M" and ev.get("name") == "thread_name"}
+    assert lanes[evs[0]["tid"]] == "events"
+
+
+# ---------------------------------------------------------------------
+# health off == zero overhead (the acceptance invariant: redist counts
+# unchanged; comm-plan goldens are covered by tests/analysis)
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("driver", ["lu", "cholesky"])
+def test_health_off_redist_counts_unchanged(grid24, driver, redist_counter):
+    rng = np.random.default_rng(79)
+    n = 24
+    arr = _spd(rng, n) if driver == "cholesky" else \
+        rng.normal(size=(n, n)) + n * np.eye(n)
+    fn = el.cholesky if driver == "cholesky" else el.lu
+    from elemental_tpu.redist.engine import redist_counts
+    with redist_counts() as off:
+        fn(_dist(grid24, arr), nb=8)
+    with redist_counts() as on:
+        fn(_dist(grid24, arr), nb=8, health=True)
+    assert dict(off) == dict(on)
+
+
+def test_monitor_engine_free(grid24, redist_counter):
+    """The monitor itself issues no engine calls: attaching it adds ZERO
+    redistribute/panel_spread entries (checked above) and its report()
+    runs off-line on host scalars."""
+    rng = np.random.default_rng(80)
+    mon = HealthMonitor()
+    el.lu(_dist(grid24, rng.normal(size=(16, 16))), nb=8, health=mon)
+    before = dict(redist_counter)
+    mon.report()
+    assert dict(redist_counter) == before
+
+
+def test_monitor_reuse_resets(grid24):
+    """Rebinding a monitor to a second driver call resets its state."""
+    rng = np.random.default_rng(81)
+    mon = HealthMonitor()
+    F = rng.normal(size=(16, 16))
+    F[1, 1] = np.nan
+    el.lu(_dist(grid24, F), nb=8, health=mon)
+    assert mon.report()["ok"] is False
+    el.lu(_dist(grid24, rng.normal(size=(16, 16)) + 16 * np.eye(16)),
+          nb=8, health=mon)
+    assert mon.report()["ok"] is True

@@ -119,3 +119,77 @@ def test_resolver_prefers_cache_and_explicit_wins(cache_env):
     assert kn["nb"] == 16
     assert kn["lookahead"] is False
     assert kn["crossover"] == 0
+
+
+# ---------------------------------------------------------------------
+# unwritable-directory degradation (ISSUE 7): warn-once + in-memory
+# fallback instead of raising mid-solve
+# ---------------------------------------------------------------------
+
+@pytest.fixture
+def unwritable_cache(tmp_path, monkeypatch):
+    """Point the cache at a path UNDER A FILE: makedirs fails with
+    NotADirectoryError on any uid (read-only-dir chmod tricks do not
+    stop root, which CI may run as)."""
+    blocker = tmp_path / "blocker.txt"
+    blocker.write_text("not a directory\n")
+    bad = str(blocker / "cache")
+    monkeypatch.setenv(tc.ENV_DIR, bad)
+    from elemental_tpu.tune.policy import clear_memo
+    clear_memo()
+    tc._MEM_FALLBACK.clear()
+    tc._WARNED_DIRS.discard(bad)
+    yield bad
+    tc._MEM_FALLBACK.clear()
+    tc._WARNED_DIRS.discard(bad)
+    clear_memo()
+
+
+def test_unwritable_dir_save_never_raises(unwritable_cache):
+    import warnings
+    from elemental_tpu.obs import metrics_scope
+    key = _key()
+    cfg = {"nb": 128, "lookahead": True, "crossover": 0}
+    with metrics_scope() as reg:
+        with pytest.warns(RuntimeWarning, match="not writable"):
+            tc.save(key, cfg)
+        # warn-once: a second save to the same dir stays silent
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            tc.save(_key(op="lu"), {"nb": 64})
+        assert not [w for w in caught
+                    if issubclass(w.category, RuntimeWarning)]
+        # loads are served from the in-process fallback
+        doc = tc.load(key)
+        assert doc is not None and doc["config"] == cfg
+        assert reg.counter_value("tune_cache_events", op="cholesky",
+                                 event="write_fallback") == 1
+        assert reg.counter_value("tune_cache_events", op="cholesky",
+                                 event="mem_hit") == 1
+    # clear() drops fallback entries too
+    assert tc.clear("cholesky") == 0
+    assert tc.load(key) is None
+
+
+def test_unwritable_dir_auto_resolution_survives(unwritable_cache):
+    """The mid-solve path: 'auto' knob resolution (which may write a
+    measured winner) must not raise on the broken cache dir."""
+    import jax
+    import jax.numpy as jnp
+    from elemental_tpu import Grid, tune
+    grid = Grid(jax.devices()[:4], height=2)
+    r = tune.resolve("cholesky", gshape=(32, 32), dtype=jnp.float32,
+                     grid=grid,
+                     requested={"nb": "auto", "lookahead": "auto",
+                                "crossover": "auto"})
+    assert r.source == "cost_model"
+    key = tc.make_key("cholesky", (32, 32), "float32", (2, 2), "cpu")
+    with pytest.warns(RuntimeWarning, match="not writable"):
+        tc.save(key, {"nb": 16, "lookahead": False, "crossover": 0})
+    tune.clear_memo()
+    r2 = tune.resolve("cholesky", gshape=(32, 32), dtype=jnp.float32,
+                      grid=grid,
+                      requested={"nb": "auto", "lookahead": "auto",
+                                 "crossover": "auto"})
+    assert r2.source == "cache"            # served from the memory fallback
+    assert r2.config["nb"] == 16

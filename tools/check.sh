@@ -1,23 +1,27 @@
 #!/usr/bin/env bash
-# One-shot pre-commit gate (ISSUE 3 + 4 + 5 + 6): style lint + comm-plan
-# lint + golden comm-plan diff + autotuner cost-model self-check + the
-# tier-1 tests/tune subset + the calu/tsqr lapack gate (comm lint/diff on
-# the lu/qr variants, golden-coverage check, lu/qr tests) + the
-# observability smoke (perf.trace run on a tiny 1x1 problem) + the
-# bench-trajectory regression gate (bench_diff).  Run
+# One-shot pre-commit gate (ISSUE 3 + 4 + 5 + 6 + 7): style lint +
+# comm-plan lint + golden comm-plan diff + autotuner cost-model
+# self-check + the tier-1 tests/tune subset + the calu/tsqr lapack gate
+# (comm lint/diff on the lu/qr variants, golden-coverage check, lu/qr
+# tests) + the observability smoke (perf.trace run on a tiny 1x1
+# problem) + the bench-trajectory regression gate (bench_diff) + the
+# resilience gate (certified-solve smoke on 1x1 + 2x2 grids incl. an
+# injected fault, and the fault-injection/health test suite).  Run
 # from anywhere; exits non-zero on ANY finding.  Future PRs run this
 # before committing -- style/comm/explain are the cheap static slice (no
-# device execution); the tune/obs tests execute small factorizations on
-# the virtual-CPU mesh (~a minute warm); the full test suite stays
-# `python -m pytest tests/ -m 'not slow'`.
+# device execution); the tune/obs/resilience tests execute small
+# factorizations on the virtual-CPU mesh (~a minute warm); the full test
+# suite stays `python -m pytest tests/ -m 'not slow'`.
 #
-#   tools/check.sh          # everything
-#   tools/check.sh style    # ruff (or the stdlib fallback) only
-#   tools/check.sh comm     # comm-plan lint + golden diff only
-#   tools/check.sh tune     # cost-model self-check + tests/tune only
-#   tools/check.sh obs      # perf.trace smoke + bench_diff gate + tests/obs
-#   tools/check.sh lapack   # calu/tsqr gate: lu/qr comm lint + golden diff,
-#                           #   golden-coverage check, lapack lu/qr tests
+#   tools/check.sh            # everything
+#   tools/check.sh style      # ruff (or the stdlib fallback) only
+#   tools/check.sh comm       # comm-plan lint + golden diff only
+#   tools/check.sh tune       # cost-model self-check + tests/tune only
+#   tools/check.sh obs        # perf.trace smoke + bench_diff gate + tests/obs
+#   tools/check.sh lapack     # calu/tsqr gate: lu/qr comm lint + golden diff,
+#                             #   golden-coverage check, lapack lu/qr tests
+#   tools/check.sh resilience # certified-solve smoke (1x1 + 2x2, CPU-safe)
+#                             #   + tests/resilience fault/health suite
 set -u
 cd "$(dirname "$0")/.."
 
@@ -99,6 +103,15 @@ if [ "$what" = "all" ] || [ "$what" = "obs" ]; then
     fi
     echo "== obs tier-1 tests =="
     python -m pytest tests/obs -q -m 'not slow' -p no:cacheprovider || rc=1
+fi
+
+if [ "$what" = "all" ] || [ "$what" = "resilience" ]; then
+    echo "== certified-solve smoke (lu + hpd, 1x1 + 2x2 grids, CPU-safe) =="
+    # clean runs must certify; a one-shot injected fault must be repaired
+    # by the escalation ladder; persistent corruption must be SURFACED
+    JAX_PLATFORMS=cpu python -m perf.certify smoke || rc=1
+    echo "== resilience tier-1 tests (fault injection + health + certify) =="
+    python -m pytest tests/resilience -q -m 'not slow' -p no:cacheprovider || rc=1
 fi
 
 if [ "$rc" -eq 0 ]; then
