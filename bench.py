@@ -74,6 +74,32 @@ def _roundtrip_latency() -> float:
 def main():
     import elemental_tpu as el
 
+    # Wire-byte accounting (ISSUE 8): a lightweight engine observer
+    # totals the ring-model byte estimate of every public redistribute /
+    # panel_spread entry at BOTH the logical dtype and the actual wire
+    # dtype (the two differ under comm_precision).  Entries fire at
+    # trace time, so jit-compiled reps count once per traced schedule --
+    # the totals are "estimated bytes per factorization", the same
+    # quantity the comm-plan goldens pin.  Defensive: obs must never
+    # fail a bench.
+    _wire_totals = {"redist_bytes": 0, "redist_wire_bytes": 0}
+    _unobserve = None
+    try:
+        from elemental_tpu.redist.engine import add_redist_observer
+        from elemental_tpu.obs.tracer import ring_bytes
+
+        def _on_redist(rec):
+            grid_shape = getattr(rec, "grid_shape", ())
+            _wire_totals["redist_bytes"] += ring_bytes(
+                rec.gshape, rec.dtype, grid_shape)
+            wire = getattr(rec, "wire_dtype", "") or rec.dtype
+            _wire_totals["redist_wire_bytes"] += ring_bytes(
+                rec.gshape, wire, grid_shape)
+
+        _unobserve = add_redist_observer(_on_redist)
+    except Exception:
+        pass
+
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
     n_chol = 32768 if on_tpu else 256
@@ -202,12 +228,17 @@ def main():
     # gate reads next to the renamed lu_n32768 metric.  (The timed runs
     # above use the pinned nb/panel for baseline comparability.)
     tuner: dict = {"ran_with": {"nb": nb, "lookahead": True,
-                                "crossover": None, "panel": "classic"}}
+                                "crossover": None, "panel": "classic",
+                                "comm_precision": None}}
     try:
         from elemental_tpu import tune as el_tune
         for op, nn in (("cholesky", n_chol), ("lu", n_lu)):
+            # comm_precision joins the resolved provenance (ISSUE 8): on
+            # this single-chip grid 'auto' resolves to None (the knob is
+            # dead without collectives); a multi-device bench records the
+            # tuner's wire-precision pick here next to nb/panel
             requested = {"nb": "auto", "lookahead": "auto",
-                         "crossover": "auto"}
+                         "crossover": "auto", "comm_precision": "auto"}
             if op == "lu":
                 requested["panel"] = "auto"
             res = el_tune.resolve(
@@ -260,6 +291,14 @@ def main():
         obs_doc["metrics"] = obs_metrics.current().to_doc(
             device=getattr(dev, "device_kind", dev.platform))
         obs_doc["phases"] = ph_summary
+        # estimated redistribution bytes, logical vs on-the-wire (equal
+        # unless a comm_precision mode ran); tools/bench_diff.py accepts
+        # the new key without tripping its rename guard
+        obs_doc["redist_bytes"] = int(_wire_totals["redist_bytes"])
+        obs_doc["redist_wire_bytes"] = int(
+            _wire_totals["redist_wire_bytes"])
+        if _unobserve is not None:
+            _unobserve()
     except Exception as e:                     # never fail the benchmark
         obs_doc["error"] = f"{type(e).__name__}: {e}"
 

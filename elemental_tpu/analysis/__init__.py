@@ -12,7 +12,8 @@ from .jaxpr_walk import (CollectiveEvent, COLLECTIVE_PRIMS, collect_events,
                          find_loop_invariant_collectives)
 from .plan import SCHEMA, CommPlan, plan_from_parts, golden_doc, diff_docs
 from .lint import LintFinding, lint_plan
-from .drivers import (DRIVERS, LOOKAHEAD_PAIRS, CALU_PAIRS, DEFAULT_N,
+from .drivers import (DRIVERS, LOOKAHEAD_PAIRS, CALU_PAIRS, COMMQ_PAIRS,
+                      COMMQ_MIN_BYTE_RATIO, DEFAULT_N,
                       DEFAULT_NB, DEFAULT_XOVER, driver_names, trace_driver,
                       trace_callable, storage_shape)
 
@@ -21,7 +22,8 @@ __all__ = [
     "count_pjit_calls", "estimate_bytes", "find_loop_invariant_collectives",
     "SCHEMA", "CommPlan", "plan_from_parts", "golden_doc", "diff_docs",
     "LintFinding", "lint_plan",
-    "DRIVERS", "LOOKAHEAD_PAIRS", "CALU_PAIRS", "DEFAULT_N", "DEFAULT_NB",
+    "DRIVERS", "LOOKAHEAD_PAIRS", "CALU_PAIRS", "COMMQ_PAIRS",
+    "COMMQ_MIN_BYTE_RATIO", "DEFAULT_N", "DEFAULT_NB",
     "DEFAULT_XOVER", "driver_names", "trace_driver", "trace_callable",
     "storage_shape",
 ]

@@ -105,29 +105,36 @@ def _herk_spec():
     return DriverSpec("herk", build)
 
 
-def _cholesky_spec(variant, lookahead, crossover):
+def _cholesky_spec(variant, lookahead, crossover, comm_precision=None):
     def build(grid, n, nb, dtype):
         from ..lapack.cholesky import cholesky
 
         def fn(a):
             return cholesky(_as_dm(a, grid, n, n), nb=nb,
-                            lookahead=lookahead, crossover=crossover)
-        meta = {"lookahead": lookahead, "crossover": crossover}
+                            lookahead=lookahead, crossover=crossover,
+                            comm_precision=comm_precision)
+        meta = {"lookahead": lookahead, "crossover": crossover,
+                "comm_precision": comm_precision}
         return fn, (_mcmr_input(grid, n, n, dtype),), meta
-    return DriverSpec(f"cholesky_{variant}", build)
+    # commq variants intentionally move bf16 on the wire (EL005 opt-in)
+    return DriverSpec(f"cholesky_{variant}", build,
+                      allow_bf16=comm_precision is not None)
 
 
-def _lu_spec(variant, lookahead, crossover, panel="classic"):
+def _lu_spec(variant, lookahead, crossover, panel="classic",
+             comm_precision=None):
     def build(grid, n, nb, dtype):
         from ..lapack.lu import lu
 
         def fn(a):
             return lu(_as_dm(a, grid, n, n), nb=nb,
-                      lookahead=lookahead, crossover=crossover, panel=panel)
+                      lookahead=lookahead, crossover=crossover, panel=panel,
+                      comm_precision=comm_precision)
         meta = {"lookahead": lookahead, "crossover": crossover,
-                "panel": panel}
+                "panel": panel, "comm_precision": comm_precision}
         return fn, (_mcmr_input(grid, n, n, dtype),), meta
-    return DriverSpec(f"lu_{variant}", build)
+    return DriverSpec(f"lu_{variant}", build,
+                      allow_bf16=comm_precision is not None)
 
 
 def _qr_spec(variant="", panel="classic"):
@@ -163,6 +170,14 @@ def _registry() -> dict:
                  panel="calu"),
         _qr_spec(),
         _qr_spec("tsqr", panel="tsqr"),
+        # commq = ISSUE 8's quantized-wire twins: the SAME schedule knobs
+        # as the baseline variant plus comm_precision='bf16', so the
+        # golden pair pins the EQuARX win exactly -- identical collective
+        # round counts, ~half the estimated wire bytes (COMMQ_PAIRS)
+        _lu_spec("calu_commq", lookahead=True, crossover=DEFAULT_XOVER,
+                 panel="calu", comm_precision="bf16"),
+        _cholesky_spec("lookahead_commq", lookahead=True, crossover=0,
+                       comm_precision="bf16"),
     ]
     return {s.name: s for s in specs}
 
@@ -186,6 +201,17 @@ LOOKAHEAD_PAIRS = (
 CALU_PAIRS = (
     ("lu_calu", ("lu_classic", "lu_crossover")),
 )
+
+#: quantized-wire pairs (ISSUE 8): (commq variant, full-precision twin) at
+#: IDENTICAL schedule knobs.  The golden tests pin, per pair on the 2x2
+#: grid: equal per-collective round counts and >= COMMQ_MIN_BYTE_RATIO x
+#: lower total estimated wire bytes -- the jaxpr-level proof that the
+#: comm_precision knob halves bytes without adding rounds.
+COMMQ_PAIRS = (
+    ("lu_calu_commq", "lu_calu"),
+    ("cholesky_lookahead_commq", "cholesky_lookahead"),
+)
+COMMQ_MIN_BYTE_RATIO = 1.9
 
 
 def driver_names() -> list:

@@ -125,12 +125,31 @@ def _mesh_axis_sizes(mesh) -> dict:
         return {}
 
 
-def _operand_aval(eqn):
+def _payload_avals(eqn):
+    """Every array operand of a collective equation.
+
+    Byte estimates must price the ACTUAL wire payload: each operand with
+    its own dtype (a tuple ``psum`` can mix dtypes, and the engine's
+    ``comm_precision`` path converts payloads to bfloat16/int8 right
+    before the collective -- assuming the driver's input dtype here would
+    over-report those by 2-4x)."""
+    out = []
     for v in eqn.invars:
         aval = getattr(v, "aval", None)
-        if aval is not None and getattr(aval, "shape", None) is not None:
-            return aval
-    return None
+        if aval is not None and getattr(aval, "shape", None) is not None \
+                and getattr(aval, "dtype", None) is not None:
+            out.append(aval)
+    return out
+
+
+def _payload_nbytes(avals) -> int:
+    total = 0
+    for aval in avals:
+        n = 1
+        for s in aval.shape:
+            n *= int(s)
+        total += n * aval.dtype.itemsize
+    return total
 
 
 def _sub_jaxprs(val):
@@ -171,13 +190,10 @@ def _walk(jaxpr, axis_env, path, mult, static, conditional, out):
         if prim in COLLECTIVE_PRIMS:
             axes = _axis_names(eqn.params)
             size = _axis_size(axes, axis_env, eqn.params)
-            aval = _operand_aval(eqn)
-            shape = tuple(int(s) for s in aval.shape) if aval is not None else ()
-            dtype = str(aval.dtype) if aval is not None else "?"
-            nbytes = 1
-            for s in shape:
-                nbytes *= s
-            nbytes *= aval.dtype.itemsize if aval is not None else 0
+            avals = _payload_avals(eqn)
+            shape = tuple(int(s) for s in avals[0].shape) if avals else ()
+            dtype = str(avals[0].dtype) if avals else "?"
+            nbytes = _payload_nbytes(avals)
             out.append(CollectiveEvent(
                 prim=prim, axes=axes, axis_size=size, shape=shape,
                 dtype=dtype,

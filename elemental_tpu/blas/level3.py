@@ -86,7 +86,8 @@ def _mask_triangle(C: DistMatrix, uplo: str, strict: bool = False):
 
 def gemm(A: DistMatrix, B: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None = None,
          orient_a: str = "N", orient_b: str = "N", alg: str = "auto",
-         nb: int | str | None = None, precision=None) -> DistMatrix:
+         nb: int | str | None = None, precision=None,
+         comm_precision: str | None = None) -> DistMatrix:
     """C := alpha op(A) op(B) + beta C on [MC,MR] (SUMMA).
 
     ``alg``: 'auto' routes through the tuning subsystem (measured-cache
@@ -96,6 +97,12 @@ def gemm(A: DistMatrix, B: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None
     'A' / 'B' / 'C' / 'dot' / 'gspmd' explicitly ('gspmd' = single
     storage matmul, XLA chooses the schedule).  ``nb='auto'`` likewise
     asks the tuner for the panel width; an explicit value always wins.
+
+    ``comm_precision`` (``None`` | ``'bf16'`` | ``'int8'`` | ``'auto'``)
+    selects the wire precision of the SUMMA panel moves (the per-panel
+    operand redistributions; GSPMD-inserted contraction psums stay full
+    precision): narrow encode -> collective -> decode, 2x fewer bytes on
+    the wire.  Opt-in; ``None`` (default) is bit-identical.
 
     Tiled ``BlockMatrix`` operands are accepted via read-proxy conversion
     (``DistMatrixReadProxy``): they re-lay out to [MC,MR] on entry; the
@@ -110,7 +117,7 @@ def gemm(A: DistMatrix, B: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None
         C = as_elemental(C)
     if ret_tiled:
         out = gemm(A, B, alpha, beta, C, orient_a, orient_b, alg, nb,
-                   precision)
+                   precision, comm_precision)
         return block_from_cyclic(out)
     A = _orient(A, orient_a)
     B = _orient(B, orient_b)
@@ -130,24 +137,27 @@ def gemm(A: DistMatrix, B: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None
         if C.gshape != (m, n):
             raise ValueError(f"C shape {C.gshape} != ({m},{n})")
 
-    if alg == "auto" or isinstance(nb, str):
+    if alg == "auto" or isinstance(nb, str) or comm_precision == "auto":
         kn = _resolve_auto("gemm", (m, k, n), C.dtype, A.grid,
-                           alg=alg, nb=nb)
-        alg, nb = kn["alg"], kn["nb"]
+                           alg=alg, nb=nb, comm_precision=comm_precision)
+        alg, nb, comm_precision = kn["alg"], kn["nb"], kn["comm_precision"]
+    from ..redist.quantize import check_comm_precision
+    check_comm_precision(comm_precision)
+    cp = comm_precision
     tm = _phase_hook("gemm", alg=alg)
     tm.start()
     if alg == "C":
-        return _summa_c(alpha, A, B, beta, C, nb, precision, tm)
+        return _summa_c(alpha, A, B, beta, C, nb, precision, tm, cp)
     if alg == "A":
-        return _summa_a(alpha, A, B, beta, C, nb, precision, tm)
+        return _summa_a(alpha, A, B, beta, C, nb, precision, tm, cp)
     if alg == "B":
-        return _summa_b(alpha, A, B, beta, C, nb, precision, tm)
+        return _summa_b(alpha, A, B, beta, C, nb, precision, tm, cp)
     if alg == "dot":
-        return _summa_dot(alpha, A, B, beta, C, precision, tm)
+        return _summa_dot(alpha, A, B, beta, C, precision, tm, cp)
     if alg == "gspmd":
         # one-shot: re-land B's k-rows on A's k-col cyclic order ([MR,STAR]),
         # then a single storage matmul -- GSPMD inserts the psum over mr.
-        Bk = redistribute(B, MR, STAR)
+        Bk = redistribute(B, MR, STAR, comm_precision=cp)
         d = jnp.matmul(A.local, Bk.local, precision=precision)
         D = DistMatrix(d, (m, n), MC, STAR, 0, 0, A.grid)
         out = redistribute(D, MC, MR)
@@ -157,7 +167,7 @@ def gemm(A: DistMatrix, B: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None
     raise ValueError(f"unknown gemm alg {alg!r}")
 
 
-def _summa_c(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK):
+def _summa_c(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK, cp=None):
     """Stationary-C (``gemm::SUMMA_NNC``): per k-panel, A1 -> [MC,STAR]
     (AllGather over mr), B1 -> [STAR,MR] (AllGather over mc), local MXU
     product accumulates into C's storage."""
@@ -168,14 +178,16 @@ def _summa_c(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK):
     acc = beta * C.local if _nonzero(beta) else jnp.zeros_like(C.local)
     for i, s in enumerate(range(0, k, kb)):
         e = min(s + kb, k)
-        A1 = redistribute(view(A, cols=(s, e)), MC, STAR)
-        B1 = redistribute(view(B, rows=(s, e)), STAR, MR)
+        A1 = redistribute(view(A, cols=(s, e)), MC, STAR,
+                          comm_precision=cp)
+        B1 = redistribute(view(B, rows=(s, e)), STAR, MR,
+                          comm_precision=cp)
         acc = acc + alpha * jnp.matmul(A1.local, B1.local, precision=precision)
         tm.tick("panel", i, acc)
     return C.with_local(_safe_astype(acc, C.dtype))
 
 
-def _summa_a(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK):
+def _summa_a(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK, cp=None):
     """Stationary-A (``gemm::SUMMA_NNA``): per C column panel, B1 ->
     [MR,STAR]; the k-contraction is sharded over mr on both operands, so the
     storage matmul lowers to local product + psum over mr -> [MC,STAR]
@@ -187,7 +199,8 @@ def _summa_a(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK):
     out = C.with_local(beta * C.local if _nonzero(beta) else jnp.zeros_like(C.local))
     for i, s in enumerate(range(0, n, jb)):
         e = min(s + jb, n)
-        B1 = redistribute(view(B, cols=(s, e)), MR, STAR)
+        B1 = redistribute(view(B, cols=(s, e)), MR, STAR,
+                          comm_precision=cp)
         d = jnp.matmul(A.local, B1.local, precision=precision)   # [MC,STAR] storage
         D1 = DistMatrix(d, (m, e - s), MC, STAR, 0, 0, A.grid)
         panel = redistribute(D1, MC, MR)
@@ -198,7 +211,7 @@ def _summa_a(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK):
     return out
 
 
-def _summa_b(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK):
+def _summa_b(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK, cp=None):
     """Stationary-B: per C row panel, A1^T -> [MC,STAR] (so the k-contraction
     is sharded over mc on both operands); local product + psum over mc ->
     [STAR,MR] partial panel, filtered onto [MC,MR]."""
@@ -209,7 +222,8 @@ def _summa_b(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK):
     out = C.with_local(beta * C.local if _nonzero(beta) else jnp.zeros_like(C.local))
     for i, s in enumerate(range(0, m, ib)):
         e = min(s + ib, m)
-        A1T = redistribute(transpose_dist(view(A, rows=(s, e))), MC, STAR)
+        A1T = redistribute(transpose_dist(view(A, rows=(s, e))), MC, STAR,
+                           comm_precision=cp)
         d = jnp.matmul(A1T.local.T, B.local, precision=precision)  # [STAR,MR] storage
         D1 = DistMatrix(d, (e - s, n), STAR, MR, 0, 0, A.grid)
         panel = redistribute(D1, MC, MR)
@@ -220,7 +234,7 @@ def _summa_b(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK):
     return out
 
 
-def _summa_dot(alpha, A, B, beta, C, precision, tm=_NULL_HOOK):
+def _summa_dot(alpha, A, B, beta, C, precision, tm=_NULL_HOOK, cp=None):
     """SUMMA-Dot (``gemm::SUMMA_NNDot``, the small-C case): shard the
     inner dimension 1-D cyclic on BOTH operands ([STAR,VC] x [VC,STAR] --
     the same cyclic permutation on each side, so the storage matmul
@@ -235,8 +249,8 @@ def _summa_dot(alpha, A, B, beta, C, precision, tm=_NULL_HOOK):
     if A.grid.size == 1:
         d = jnp.matmul(A.local, B.local, precision=precision)
     else:
-        Avc = redistribute(A, STAR, VC)
-        Bvc = redistribute(B, VC, STAR)
+        Avc = redistribute(A, STAR, VC, comm_precision=cp)
+        Bvc = redistribute(B, VC, STAR, comm_precision=cp)
         dl = jnp.matmul(Avc.local, Bvc.local, precision=precision)
         D = DistMatrix(dl, (m, n), STAR, STAR, 0, 0, A.grid)
         d = redistribute(D, MC, MR).local
@@ -287,21 +301,26 @@ def trrk(uplo: str, alpha, A_mc: DistMatrix, B_mr: DistMatrix, beta, C: DistMatr
 
 def herk(uplo: str, A: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None = None,
          orient: str = "N", nb: int | str | None = None, precision=None,
-         conj: bool = True) -> DistMatrix:
+         conj: bool = True, comm_precision: str | None = None) -> DistMatrix:
     """C(tri) := alpha op(A) op(A)^H + beta C(tri)  (orient 'N' or 'C'/'T').
 
     Per k-panel: A1 -> [VC,STAR], then the fused engine ``panel_spread``
     produces the [MC,STAR] panel and its [STAR,MR] adjoint in ONE
     collective round (the Cholesky trailing-update chain, cf.
     ``cholesky::LVar3``); masked local update.  ``nb='auto'`` asks the
-    tuning subsystem for the k-panel width.
+    tuning subsystem for the k-panel width.  ``comm_precision`` selects
+    the wire precision of the panel move + spread (see :func:`gemm`).
     """
     if orient != "N":
         A = _orient(A, "C" if conj else "T")
     _check_mcmr(A)
     m, k = A.gshape
-    if isinstance(nb, str):
-        nb = _resolve_auto("herk", (m, k), A.dtype, A.grid, nb=nb)["nb"]
+    if isinstance(nb, str) or comm_precision == "auto":
+        kn = _resolve_auto("herk", (m, k), A.dtype, A.grid, nb=nb,
+                           comm_precision=comm_precision)
+        nb, comm_precision = kn["nb"], kn["comm_precision"]
+    from ..redist.quantize import check_comm_precision
+    check_comm_precision(comm_precision)
     r, c = A.grid.height, A.grid.width
     if C is None:
         C = dm_zeros(m, m, MC, MR, A.grid, dtype=A.dtype)
@@ -317,8 +336,10 @@ def herk(uplo: str, A: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None = N
     acc = beta * C.local if _nonzero(beta) else jnp.zeros_like(C.local)
     for i, s in enumerate(range(0, k, kb)):
         e = min(s + kb, k)
-        A1_vc = redistribute(view(A, cols=(s, e)), VC, STAR)
-        A1_mc, A1H_mr = panel_spread(A1_vc, conj=conj)
+        A1_vc = redistribute(view(A, cols=(s, e)), VC, STAR,
+                             comm_precision=comm_precision)
+        A1_mc, A1H_mr = panel_spread(A1_vc, conj=conj,
+                                     comm_precision=comm_precision)
         tm.tick("spread", i, A1_mc.local, A1H_mr.local)
         acc = acc + alpha * jnp.matmul(A1_mc.local, A1H_mr.local, precision=precision)
         tm.tick("update", i, acc)
@@ -337,16 +358,23 @@ def syrk(uplo: str, A: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None = N
 
 def trsm(side: str, uplo: str, orient: str, A: DistMatrix, B: DistMatrix,
          alpha=1.0, unit: bool = False, nb: int | str | None = None,
-         precision=None) -> DistMatrix:
+         precision=None, comm_precision: str | None = None) -> DistMatrix:
     """Solve op(A) X = alpha B (side 'L') or X op(A) = alpha B (side 'R');
     A triangular [MC,MR].  Reference: ``El::Trsm`` 8 side/uplo/orientation
     cases (``src/blas_like/level3/Trsm/*.hpp``).
 
     ``nb='auto'`` asks the tuning subsystem for the panel width (explicit
     values always win).  Right-side solves reduce to left solves of the
-    transposed system (X op(A) = B  <=>  op(A)^T X^T = B^T)."""
-    if isinstance(nb, str):
-        nb = _resolve_auto("trsm", B.gshape, B.dtype, B.grid, nb=nb)["nb"]
+    transposed system (X op(A) = B  <=>  op(A)^T X^T = B^T).
+    ``comm_precision`` selects the wire precision of the panel moves
+    (diagonal-block gathers, RHS panel transport, off-diagonal operand
+    moves; see :func:`gemm`)."""
+    if isinstance(nb, str) or comm_precision == "auto":
+        kn = _resolve_auto("trsm", B.gshape, B.dtype, B.grid, nb=nb,
+                           comm_precision=comm_precision)
+        nb, comm_precision = kn["nb"], kn["comm_precision"]
+    from ..redist.quantize import check_comm_precision
+    check_comm_precision(comm_precision)
     tm = _phase_hook("trsm")
     tm.start()
     trans = orient in ("T", "C")
@@ -355,14 +383,15 @@ def trsm(side: str, uplo: str, orient: str, A: DistMatrix, B: DistMatrix,
         BT = redistribute(transpose_dist(B), MC, MR)
         # op(A)^T: N -> T; T -> N; C -> conj-only (trans=False, conj=True)
         XT = _trsm_left(uplo, not trans, conj, A, BT, alpha, unit, nb,
-                        precision, tm)
+                        precision, tm, comm_precision)
         return redistribute(transpose_dist(XT), MC, MR)
-    return _trsm_left(uplo, trans, conj, A, B, alpha, unit, nb, precision, tm)
+    return _trsm_left(uplo, trans, conj, A, B, alpha, unit, nb, precision,
+                      tm, comm_precision)
 
 
 def _trsm_left(uplo: str, trans: bool, conj: bool, A: DistMatrix, B: DistMatrix,
                alpha, unit: bool, nb: int | None, precision,
-               tm=_NULL_HOOK) -> DistMatrix:
+               tm=_NULL_HOOK, cp=None) -> DistMatrix:
     """All eight left cases.  Effective triangle: uplo XOR trans decides the
     sweep direction; per panel the diagonal block is replicated
     ([STAR,STAR]), the RHS panel goes 1-D cyclic ([STAR,VR]) for the local
@@ -382,16 +411,17 @@ def _trsm_left(uplo: str, trans: bool, conj: bool, A: DistMatrix, B: DistMatrix,
         starts = starts[::-1]
     for k, s in enumerate(starts):
         e = min(s + ib, m)
-        A11 = redistribute(view(A, rows=(s, e), cols=(s, e)), STAR, STAR)
+        A11 = redistribute(view(A, rows=(s, e), cols=(s, e)), STAR, STAR,
+                           comm_precision=cp)
         # mask to the stored triangle so opposite-triangle garbage (e.g. the
         # packed L\U format of lu()) can never leak into the solve
         a11 = jnp.tril(A11.local) if lower else jnp.triu(A11.local)
-        B1 = redistribute(view(X, rows=(s, e)), STAR, VR)
+        B1 = redistribute(view(X, rows=(s, e)), STAR, VR, comm_precision=cp)
         x1 = lax.linalg.triangular_solve(
             a11, B1.local, left_side=True, lower=lower,
             transpose_a=trans, conjugate_a=conj, unit_diagonal=unit)
         X1 = DistMatrix(x1, B1.gshape, STAR, VR, 0, 0, A.grid)
-        X1_mr = redistribute(X1, STAR, MR)
+        X1_mr = redistribute(X1, STAR, MR, comm_precision=cp)
         X = update_view(X, redistribute(X1_mr, MC, MR), rows=(s, e))  # local filter
         tm.tick("solve", k, X.local)
         # trailing update of the not-yet-solved rows
@@ -400,10 +430,12 @@ def _trsm_left(uplo: str, trans: bool, conj: bool, A: DistMatrix, B: DistMatrix,
             continue
         if trans:
             # T21 = op(A)[hi-part, s:e] = op(A[s:e, hi-part])
-            A1p = redistribute(view(A, rows=(s, e), cols=(lo, hi)), STAR, MC)
+            A1p = redistribute(view(A, rows=(s, e), cols=(lo, hi)), STAR, MC,
+                               comm_precision=cp)
             a_loc = A1p.local.T            # [MC,STAR]-storage of A1p^T
         else:
-            A1p = redistribute(view(A, rows=(lo, hi), cols=(s, e)), MC, STAR)
+            A1p = redistribute(view(A, rows=(lo, hi), cols=(s, e)), MC, STAR,
+                               comm_precision=cp)
             a_loc = A1p.local
         if conj:
             a_loc = jnp.conj(a_loc)

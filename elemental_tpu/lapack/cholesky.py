@@ -61,6 +61,7 @@ from ..core.dist import MC, MR, VC, STAR
 from ..core.distmatrix import DistMatrix
 from ..core.view import view, update_view
 from ..redist.engine import redistribute, transpose_dist, panel_spread
+from ..redist.quantize import check_comm_precision
 from ..blas.level1 import make_trapezoidal, _global_indices
 from ..blas.level3 import _blocksize, _check_mcmr, _mask_triangle, trsm
 from .lu import _hi, _NULL_TIMER, _phase_hook
@@ -236,7 +237,8 @@ def _local_cholesky(A: DistMatrix, nb: int | None, precision,
 
 def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
              precision=None, lookahead: bool | str = True,
-             crossover: int | str | None = None, timer=None,
+             crossover: int | str | None = None,
+             comm_precision: str | None = None, timer=None,
              health=None) -> DistMatrix:
     """Cholesky factor of an HPD [MC,MR] matrix; reads only the ``uplo``
     triangle.  Returns L (A = L L^H) for 'L', U (A = U^H U) for 'U'.
@@ -248,10 +250,20 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
     with look-ahead, disabled classic; 0 never crosses over); ``timer``
     enables eager per-phase wall-clock attribution (``perf/phase_timer.py``).
 
-    Any of ``nb`` / ``lookahead`` / ``crossover`` may be ``'auto'``: the
-    tuning subsystem resolves them per (shape, dtype, grid, backend) --
-    measured-cache winner first, analytic cost model cold (explicit
-    values always win; see ``elemental_tpu/tune``).
+    ``comm_precision`` (``None`` | ``'bf16'`` | ``'int8'``) selects the
+    WIRE precision of the schedule's redistributions -- the diagonal-block
+    gathers, the [VC,STAR] panel moves, the fused ``panel_spread`` and
+    the crossover tail gather all encode narrow, move 2-4x fewer bytes
+    at identical round counts, and decode back before any local math
+    (see ``redist.quantize``).  Opt-in: ``None`` (default) is
+    bit-identical; quantized wire raises the factor residual to the
+    ~1e-2..1e-3 relative level -- pair with
+    ``resilience.certified_solve('hpd', ...)`` for certified answers.
+
+    Any of ``nb`` / ``lookahead`` / ``crossover`` / ``comm_precision``
+    may be ``'auto'``: the tuning subsystem resolves them per (shape,
+    dtype, grid, backend) -- measured-cache winner first, analytic cost
+    model cold (explicit values always win; see ``elemental_tpu/tune``).
 
     ``health`` opts into the resilience guards (NaN/Inf scans, growth
     estimate, non-positive/near-zero diagonal detection on the ``diag``
@@ -259,18 +271,23 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
     ``lu(..., health=...)``; ``None`` (default) attaches nothing.
     """
     _check_mcmr(A)
-    if any(isinstance(v, str) for v in (nb, lookahead, crossover)):
+    if any(isinstance(v, str) for v in (nb, lookahead, crossover)) \
+            or comm_precision == "auto":
         from ..tune.policy import resolve_knobs
         kn = resolve_knobs("cholesky", gshape=A.gshape, dtype=A.dtype,
                            grid=A.grid, knobs={"nb": nb, "lookahead": lookahead,
-                                               "crossover": crossover})
+                                               "crossover": crossover,
+                                               "comm_precision": comm_precision})
         nb, lookahead, crossover = kn["nb"], kn["lookahead"], kn["crossover"]
+        comm_precision = kn["comm_precision"]
+    check_comm_precision(comm_precision)
     if uplo.upper().startswith("U"):
         # U = (lower factor of A^H-as-lower)^H; A hermitian so the data of
         # the upper triangle, conj-transposed, is the lower triangle.
         Alow = redistribute(transpose_dist(A, conj=True), MC, MR)
         L = cholesky(Alow, "L", nb=nb, precision=precision,
-                     lookahead=lookahead, crossover=crossover, timer=timer,
+                     lookahead=lookahead, crossover=crossover,
+                     comm_precision=comm_precision, timer=timer,
                      health=health)
         return redistribute(transpose_dist(L, conj=True), MC, MR)
 
@@ -297,13 +314,14 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
     if lookahead:
         # prologue: factor diag block 0 + solve panel 0 from the input
         e0 = min(ib, m)
-        A11 = redistribute(view(L, rows=(0, e0), cols=(0, e0)), STAR, STAR)
+        A11 = redistribute(view(L, rows=(0, e0), cols=(0, e0)), STAR, STAR,
+                           comm_precision=comm_precision)
         L11, Li11 = _potrf_inv(A11.local, precision)
         tm.tick("diag", 0, L11)
         L21_vc = None
         if e0 < m:
             A21_vc = redistribute(view(L, rows=(e0, m), cols=(0, e0)),
-                                  VC, STAR)
+                                  VC, STAR, comm_precision=comm_precision)
             x21 = jnp.matmul(A21_vc.local, jnp.conj(Li11).T,
                              precision=_hi(precision)).astype(L.dtype)
             L21_vc = DistMatrix(x21, (m - e0, e0), VC, STAR, 0, 0, g)
@@ -314,7 +332,8 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
         if lookahead:
             L11, Li11, L21_vc = nxt
         else:
-            A11 = redistribute(view(L, rows=(s, e), cols=(s, e)), STAR, STAR)
+            A11 = redistribute(view(L, rows=(s, e), cols=(s, e)),
+                               STAR, STAR, comm_precision=comm_precision)
             # replicated diagonal-block factor + inverse: every device runs
             # the same deterministic _potrf_inv, so the panel Trsm below is
             # a matmul
@@ -325,12 +344,14 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
         if e == m:
             break
         if not lookahead:
-            A21_vc = redistribute(view(L, rows=(e, m), cols=(s, e)), VC, STAR)
+            A21_vc = redistribute(view(L, rows=(e, m), cols=(s, e)),
+                                  VC, STAR, comm_precision=comm_precision)
             x21 = jnp.matmul(A21_vc.local, jnp.conj(Li11).T,
                              precision=_hi(precision)).astype(L.dtype)  # A21 L11^{-H}
             L21_vc = DistMatrix(x21, (m - e, e - s), VC, STAR, 0, 0, g)
             tm.tick("panel", k, L21_vc)
-        L21_mc, L21H_mr = panel_spread(L21_vc, conj=True)
+        L21_mc, L21H_mr = panel_spread(L21_vc, conj=True,
+                                       comm_precision=comm_precision)
         tm.tick("spread", k, L21_mc, L21H_mr)
         tail = bool(xover) and m - e <= xover
         if not lookahead:
@@ -356,13 +377,15 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
                 # factor diag block k+1 + solve panel k+1 from the strip,
                 # off the critical path of the wide remainder update
                 A11n = redistribute(view(stripD, rows=(0, e2 - e),
-                                         cols=(0, e2 - e)), STAR, STAR)
+                                         cols=(0, e2 - e)), STAR, STAR,
+                                    comm_precision=comm_precision)
                 L11n, Li11n = _potrf_inv(A11n.local, precision)
                 tm.tick("diag", k + 1, L11n)
                 L21n_vc = None
                 if e2 < m:
                     A21n = redistribute(view(stripD, rows=(e2 - e, m - e),
-                                             cols=(0, e2 - e)), VC, STAR)
+                                             cols=(0, e2 - e)), VC, STAR,
+                                        comm_precision=comm_precision)
                     x21n = jnp.matmul(A21n.local, jnp.conj(Li11n).T,
                                       precision=_hi(precision)).astype(L.dtype)
                     L21n_vc = DistMatrix(x21n, (m - e2, e2 - e), VC, STAR,
@@ -392,7 +415,9 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
             # block, replicated sequential finish, one scatter back -- the
             # remaining t/nb steps of per-step collective latency collapse
             # into a single round trip
-            Atail = redistribute(view(L, rows=(e, m), cols=(e, m)), STAR, STAR)
+            Atail = redistribute(view(L, rows=(e, m), cols=(e, m)),
+                                 STAR, STAR,
+                                 comm_precision=comm_precision)
             lt = _local_chol_array(Atail.local, m - e, ib, precision,
                                    lookahead=lookahead)
             Lt_ss = DistMatrix(lt, (m - e, m - e), STAR, STAR, 0, 0, g)

@@ -82,6 +82,7 @@ from ..core.dist import MC, MR, STAR, VC, VR
 from ..core.distmatrix import DistMatrix
 from ..core.view import view, update_view
 from ..redist.engine import move_rows, permute_rows_storage, redistribute
+from ..redist.quantize import check_comm_precision, quantizable
 from ..blas.level3 import _blocksize, _check_mcmr, local_rank_update, trsm
 
 #: chunk-width ladder for the replicated panel factorization.  A/B-measured
@@ -450,8 +451,8 @@ def _moved_rows(pperm, nbw: int):
 # one-collective row-block solve (the CALU schedule's U12 path)
 # ---------------------------------------------------------------------
 
-@partial(jax.jit, static_argnums=(2,))
-def _rowblock_solve_jit(Ablk: DistMatrix, Li11, precision):
+@partial(jax.jit, static_argnums=(2, 3))
+def _rowblock_solve_jit(Ablk: DistMatrix, Li11, precision, wire=None):
     """``U = Li11 @ Ablk`` for an (nbw, w) [MC,MR] row block, landing
     [STAR,MR] in ONE psum round.
 
@@ -462,7 +463,12 @@ def _rowblock_solve_jit(Ablk: DistMatrix, Li11, precision):
     (columns ``mc + r*iLoc`` of ``Li11``) and one ``psum`` over the grid
     column completes the product -- the contraction is genuinely
     distributed over grid rows, r-fold less panel-solve compute per
-    device AND one round instead of two."""
+    device AND one round instead of two.
+
+    ``wire='bf16'`` runs the psum on a bfloat16 payload (the
+    ``comm_precision`` path: reductions never ride int8 -- integer
+    accumulation would overflow the block scale -- so both quantized
+    modes reduce at bf16; local math stays at ``precision``)."""
     g = Ablk.grid
     r = g.height
     nbw = Ablk.gshape[0]
@@ -476,7 +482,10 @@ def _rowblock_solve_jit(Ablk: DistMatrix, Li11, precision):
         Lsub = jnp.take(L, jnp.clip(cols, 0, nbw - 1), axis=1)
         Lsub = jnp.where(okc[None, :], Lsub, 0)
         part = jnp.matmul(Lsub, ab.local, precision=precision)
-        out = lax.psum(part, "mc")
+        if wire == "bf16":
+            out = lax.psum(part.astype(jnp.bfloat16), "mc").astype(part.dtype)
+        else:
+            out = lax.psum(part, "mc")
         return DistMatrix(out, ab.gshape, STAR, MR, 0, ab.ralign, g)
 
     from jax.sharding import PartitionSpec as P
@@ -582,7 +591,7 @@ _CROSSOVER = 4096
 def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
        update_precision=None, lookahead: bool | str = True,
        crossover: int | str | None = None, panel: str = "classic",
-       timer=None, health=None):
+       comm_precision: str | None = None, timer=None, health=None):
     """Blocked right-looking LU with partial pivoting and look-ahead.
 
     Returns (LU, perm): LU holds unit-lower L below the diagonal and U on
@@ -618,12 +627,25 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
         exactly.  The crossover tail finishes with the local classic
         kernel under either strategy.
 
-    ``nb`` / ``lookahead`` / ``crossover`` / ``panel`` accept ``'auto'``:
-    the tuning subsystem (``elemental_tpu/tune``) resolves them per
-    (shape, dtype, grid, backend) -- measured-cache winner first, analytic
-    cost model cold; explicit values always win.  ``panel='auto'`` picks
-    calu on multi-row grids and classic on single-row ones (the pivot
-    latency term of the cost model).
+    ``comm_precision`` (``None`` | ``'bf16'`` | ``'int8'``) selects the
+    WIRE precision of the schedule's bulk redistributions (panel gathers,
+    the U12 row-block transport, the crossover tail gather; the CALU
+    row-block psum reduces at bf16 under either mode): payloads are
+    block-scale encoded before each collective and decoded after, so
+    gathers move 2-4x fewer bytes at identical round counts while all
+    local math keeps ``precision``.  Opt-in: ``None`` (default) is
+    bit-identical to the unquantized schedule (pinned by tests).  bf16
+    wire raises the factor residual to the ~1e-2..1e-3 relative level
+    (int8 similar; see README "Quantized collectives") -- pair with
+    ``resilience.certified_solve`` for certified answers.
+
+    ``nb`` / ``lookahead`` / ``crossover`` / ``panel`` /
+    ``comm_precision`` accept ``'auto'``: the tuning subsystem
+    (``elemental_tpu/tune``) resolves them per (shape, dtype, grid,
+    backend) -- measured-cache winner first, analytic cost model cold;
+    explicit values always win.  ``panel='auto'`` picks calu on
+    multi-row grids and classic on single-row ones (the pivot latency
+    term of the cost model).
 
     ``health`` opts into the resilience subsystem's numerical-health
     guards (``elemental_tpu/resilience``): pass a ``HealthMonitor`` (read
@@ -635,13 +657,15 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
     NULL_HOOK path, pinned by the redist-count goldens."""
     _check_mcmr(A)
     if any(isinstance(v, str) for v in (nb, lookahead, crossover)) \
-            or panel == "auto":
+            or panel == "auto" or comm_precision == "auto":
         from ..tune.policy import resolve_knobs
         kn = resolve_knobs("lu", gshape=A.gshape, dtype=A.dtype, grid=A.grid,
                            knobs={"nb": nb, "lookahead": lookahead,
-                                  "crossover": crossover, "panel": panel})
+                                  "crossover": crossover, "panel": panel,
+                                  "comm_precision": comm_precision})
         nb, lookahead, crossover = kn["nb"], kn["lookahead"], kn["crossover"]
-        panel = kn["panel"]
+        panel, comm_precision = kn["panel"], kn["comm_precision"]
+    check_comm_precision(comm_precision)
     if panel is None:
         panel = "classic"
     if panel not in ("classic", "calu"):
@@ -689,7 +713,7 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
     if lookahead:
         e0_up = col_up(min(ib, kend))
         panel0 = redistribute(view(A, rows=(0, m), cols=(0, e0_up)),
-                              STAR, STAR)
+                              STAR, STAR, comm_precision=comm_precision)
         nxt = factor_panel(panel0.local[:, :min(ib, kend)], min(ib, kend), 0)
         tm.tick("panel", 0, nxt)
     for k, s in enumerate(range(0, kend, ib)):
@@ -705,7 +729,8 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
             Pf, pperm = nxt
         else:
             panel = redistribute(view(A, rows=(s, m), cols=(s, e_up)),
-                                 STAR, STAR)
+                                 STAR, STAR,
+                                 comm_precision=comm_precision)
             Pf, pperm = factor_panel(panel.local[:, :nbw], nbw, k)
             tm.tick("panel", k, Pf, pperm)
         perm = perm.at[s:].set(jnp.take(perm[s:], pperm, axis=0))
@@ -735,14 +760,17 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
             # rows distributes across grid rows and a single psum lands
             # [STAR,MR] -- one round instead of the classic all_to_all +
             # all_gather pair below
-            U1n_mr = _rowblock_solve_jit(view(A, rows=(s, e), cols=(s, n)),
-                                         Li11, _hi(precision))
+            U1n_mr = _rowblock_solve_jit(
+                view(A, rows=(s, e), cols=(s, n)), Li11, _hi(precision),
+                "bf16" if comm_precision and quantizable(A.dtype) else None)
         else:
-            A1n = redistribute(view(A, rows=(s, e), cols=(s, n)), STAR, VR)
+            A1n = redistribute(view(A, rows=(s, e), cols=(s, n)),
+                               STAR, VR, comm_precision=comm_precision)
             u1n = jnp.matmul(Li11, A1n.local, precision=_hi(precision)
                              ).astype(Pf.dtype)
             U1n = DistMatrix(u1n, (nbw, n - s), STAR, VR, 0, 0, g)
-            U1n_mr = redistribute(U1n, STAR, MR)
+            U1n_mr = redistribute(U1n, STAR, MR,
+                                  comm_precision=comm_precision)
         tm.tick("solve", k, U1n_mr)
         if not lookahead or e >= kend:
             A = _update_cols_ge(A, redistribute(U1n_mr, MC, MR), (s, e),
@@ -758,7 +786,7 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
                 tm.tick("update", k, A)
             if tail:
                 A, perm = _lu_tail(A, perm, e, ib, precision, upd,
-                                   lookahead, tm, k)
+                                   lookahead, tm, k, comm_precision)
                 break
             continue
         # look-ahead: split the trailing update at the next panel boundary.
@@ -778,7 +806,8 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
             # factor panel k+1 from the freshly updated strip (gshape
             # already (m-e, e2_up-e) from the view metadata); skipped when
             # the tail finish below refactors the whole trailing block
-            strip_ss = redistribute(stripD, STAR, STAR)
+            strip_ss = redistribute(stripD, STAR, STAR,
+                                    comm_precision=comm_precision)
             nxt = factor_panel(strip_ss.local[:, :e2 - e], e2 - e, k + 1)
             tm.tick("panel", k + 1, nxt)
         # (b) wide remainder update, cols >= e2_up
@@ -799,7 +828,7 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
         tm.tick("update", k, A)
         if tail:
             A, perm = _lu_tail(A, perm, e, ib, precision, upd, lookahead,
-                               tm, k)
+                               tm, k, comm_precision)
             break
     if hm is not None:
         hm.report()
@@ -807,7 +836,7 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
 
 
 def _lu_tail(A: DistMatrix, perm, e: int, ib: int, precision, upd,
-             lookahead: bool, tm, k: int):
+             lookahead: bool, tm, k: int, comm_precision=None):
     """Crossover-to-local finish of the (fully updated) trailing block.
 
     One [STAR,STAR] gather of rows/cols >= e, a replicated run of the
@@ -818,7 +847,8 @@ def _lu_tail(A: DistMatrix, perm, e: int, ib: int, precision, upd,
     collective latency collapse into a single round trip."""
     m, n = A.gshape
     g = A.grid
-    Atail = redistribute(view(A, rows=(e, m), cols=(e, n)), STAR, STAR)
+    Atail = redistribute(view(A, rows=(e, m), cols=(e, n)), STAR, STAR,
+                         comm_precision=comm_precision)
     at, pt = _local_lu_array(Atail.local, m - e, n - e, ib, precision,
                              upd, lookahead)
     # the tail's composed row permutation applies to the WHOLE row range

@@ -194,7 +194,8 @@ def _panel_qr_tsqr(P, r: int, precision=None):
 # ---------------------------------------------------------------------
 
 def qr(A: DistMatrix, nb: int | str | None = None, precision=None,
-       panel: str = "classic", timer=None):
+       panel: str = "classic", comm_precision: str | None = None,
+       timer=None):
     """Blocked Householder QR; returns (packed, tau) in geqrf format.
 
     ``nb='auto'`` asks the tuning subsystem for the panel width.  The
@@ -214,15 +215,26 @@ def qr(A: DistMatrix, nb: int | str | None = None, precision=None,
     back into the SAME geqrf packing, so ``apply_q``/``least_squares``
     consume the result unchanged (R's diagonal signs may differ from
     classic; the (packed, tau) pair is self-consistent).  ``'auto'``
-    resolves through the tuning subsystem like ``nb``."""
+    resolves through the tuning subsystem like ``nb``.
+
+    ``comm_precision`` (``None`` | ``'bf16'`` | ``'int8'`` | ``'auto'``)
+    selects the wire precision of the per-step panel gathers (the
+    sweep's only bulk collective): narrow encode -> gather -> decode, so
+    the gathers move 2-4x fewer bytes at identical round counts.
+    Opt-in; ``None`` (default) is bit-identical.  See the README's
+    "Quantized collectives" section for the accuracy trade."""
     _check_mcmr(A)
     m, n = A.gshape
     g = A.grid
-    if isinstance(nb, str) or panel == "auto":
+    if isinstance(nb, str) or panel == "auto" or comm_precision == "auto":
         from ..tune.policy import resolve_knobs
         kn = resolve_knobs("qr", gshape=A.gshape, dtype=A.dtype, grid=g,
-                           knobs={"nb": nb, "panel": panel})
-        nb, panel = kn["nb"], kn["panel"]
+                           knobs={"nb": nb, "panel": panel,
+                                  "comm_precision": comm_precision})
+        nb, panel, comm_precision = kn["nb"], kn["panel"], \
+            kn["comm_precision"]
+    from ..redist.quantize import check_comm_precision
+    check_comm_precision(comm_precision)
     if panel is None:
         panel = "classic"
     if panel not in ("classic", "tsqr"):
@@ -239,7 +251,8 @@ def qr(A: DistMatrix, nb: int | str | None = None, precision=None,
         nbw = e - s
         e_up = min(-(-e // c) * c, n)
         panel_ss = redistribute(view(A, rows=(s, m), cols=(s, e_up)),
-                                STAR, STAR)
+                                STAR, STAR,
+                                comm_precision=comm_precision)
         if panel == "tsqr":
             Pf, tau = _panel_qr_tsqr(panel_ss.local[:, :nbw], r, precision)
         else:

@@ -139,6 +139,10 @@ def chrome_trace_doc(tracer: Tracer, **meta) -> dict:
                        "name": ev.label, "ts": us(ev.t),
                        "args": {"kind": ev.kind, "gshape": list(ev.gshape),
                                 "dtype": ev.dtype, "bytes": ev.bytes,
+                                "wire_dtype": getattr(ev, "wire_dtype", "")
+                                or ev.dtype,
+                                "wire_bytes": getattr(ev, "wire_bytes", 0)
+                                or ev.bytes,
                                 "driver": ev.driver, "span": ev.span}})
     # generic instants (health flags, ...) on a dedicated events track
     etid = _instant_tid(lanes, bool(tracer.comms))

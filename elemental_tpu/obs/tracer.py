@@ -93,9 +93,14 @@ class CommEvent:
     label: str                   # "[MC,MR]->[STAR,STAR]" | "panel_spread"
     gshape: tuple
     dtype: str
-    bytes: int                   # ring-model estimate (see ring_bytes)
+    bytes: int                   # ring-model estimate at the LOGICAL dtype
     span: str | None             # innermost open explicit span
     driver: str | None           # most recent driver channel
+    #: dtype/bytes actually on the wire: == dtype/bytes unless the entry
+    #: ran under a ``comm_precision`` mode (ISSUE 8), where the payload
+    #: is bfloat16/int8 and wire_bytes shows the 2-4x drop
+    wire_dtype: str = ""
+    wire_bytes: int = 0
 
 
 def ring_bytes(gshape, dtype, grid_shape) -> int:
@@ -244,16 +249,20 @@ class Tracer:
 
     # ---- engine observer --------------------------------------------
     def _on_redist(self, rec) -> None:
-        nbytes = ring_bytes(rec.gshape, rec.dtype,
-                            getattr(rec, "grid_shape", ()))
+        grid_shape = getattr(rec, "grid_shape", ())
+        nbytes = ring_bytes(rec.gshape, rec.dtype, grid_shape)
+        wire = getattr(rec, "wire_dtype", "") or rec.dtype
+        wbytes = nbytes if wire == rec.dtype \
+            else ring_bytes(rec.gshape, wire, grid_shape)
         self.comms.append(CommEvent(
             t=self.clock(), kind=rec.kind, label=rec.label,
             gshape=tuple(rec.gshape), dtype=rec.dtype, bytes=nbytes,
             span=self._stack[-1].name if self._stack else None,
-            driver=self._cur_driver))
+            driver=self._cur_driver, wire_dtype=wire, wire_bytes=wbytes))
         if self._metrics:
             _metrics.inc("redist_calls", label=rec.label)
             _metrics.inc("redist_bytes", nbytes, label=rec.label)
+            _metrics.inc("redist_wire_bytes", wbytes, label=rec.label)
 
     # ---- activation --------------------------------------------------
     def __enter__(self) -> "Tracer":
@@ -284,6 +293,13 @@ class Tracer:
 
     def redist_bytes_total(self) -> int:
         return sum(ev.bytes for ev in self.comms)
+
+    def redist_wire_bytes_total(self) -> int:
+        """Total estimated bytes actually moved on the wire -- equals
+        :meth:`redist_bytes_total` unless some entries ran under a
+        ``comm_precision`` mode (the quantized-collective win, measurable
+        end-to-end here)."""
+        return sum(ev.wire_bytes for ev in self.comms)
 
     def phase_totals(self) -> dict:
         """{driver: {phase: seconds}} aggregated over all records."""

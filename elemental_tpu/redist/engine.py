@@ -42,6 +42,8 @@ from ..core.dist import (
     stride as dist_stride, gather_axes, rank_of, md_slot_of_global,
 )
 from ..core.distmatrix import DistMatrix, _check_pair
+from .quantize import (QUANT_TILE, check_comm_precision, q8_pack, q8_unpack,
+                       quantizable)
 
 
 #: Trace-time instrumentation: public-entry call counts, keyed by
@@ -96,6 +98,9 @@ class RedistRecord:
     in_id: int           # id() of the source local array/tracer
     out_ids: tuple       # id() of the produced local array(s)/tracer(s)
     grid_shape: tuple = ()   # (r, c) of the grid (obs ring-byte estimates)
+    #: dtype actually moved on the wire (== ``dtype`` unless the entry ran
+    #: under a ``comm_precision`` mode -- "bfloat16" / "int8" then)
+    wire_dtype: str = ""
     # live references keep the ids above unambiguous (no id reuse after GC)
     refs: tuple = dataclasses.field(default=(), repr=False, compare=False)
 
@@ -177,13 +182,14 @@ def fault_injection(plan):
 
 
 def _trace_record(kind, src, dst, gshape, dtype, objs_in, objs_out,
-                  grid_shape=()):
+                  grid_shape=(), wire_dtype=None):
     if _REDIST_TRACE is None and not _REDIST_OBSERVERS:
         return
     rec = RedistRecord(
         kind=kind, src=tuple(src), dst=tuple(dst), gshape=tuple(gshape),
         dtype=str(dtype), in_id=id(objs_in),
         out_ids=tuple(id(o) for o in objs_out), grid_shape=tuple(grid_shape),
+        wire_dtype=str(wire_dtype or dtype),
         refs=(objs_in,) + tuple(objs_out))
     if _REDIST_TRACE is not None:
         _REDIST_TRACE.append(rec)
@@ -679,6 +685,104 @@ def _retag(A: DistMatrix, dim: int, d: Dist, loc) -> DistMatrix:
 
 
 # ---------------------------------------------------------------------
+# quantized wire precision (the ``comm_precision`` knob, ISSUE 8 --
+# EQuARX direction, PAPERS.md 2506.17615): encode the payload narrow,
+# run the SAME collective schedule on it, decode on the far side.  The
+# codec lives in :mod:`.quantize`; this section is the engine routing.
+# ---------------------------------------------------------------------
+
+#: wire dtype names recorded on RedistRecord per resolved mode
+_WIRE_DTYPES = {"bf16": "bfloat16", "int8": "int8"}
+
+#: dists the fused int8 gather kernels understand (MD's slot permutation
+#: and CIRC's eager bridge stay full precision)
+_Q8_DISTS = frozenset({MC, MR, VC, VR, STAR})
+
+
+def _wire_mode(A: DistMatrix, mode, q8_ok: bool):
+    """Resolve a requested ``comm_precision`` to the wire mode actually
+    run: ``None`` (bit-identical full precision), ``'bf16'``, or
+    ``'int8'``.
+
+    ``None`` is returned -- regardless of the request -- whenever
+    quantization could not save a byte or would corrupt a non-codec
+    payload: 1x1 grids (collectives elide), non-real-float dtypes, and
+    replicated sources (pure-local filters).  ``'int8'`` requires a
+    dedicated fused kernel (``q8_ok``: the gather-to-replicated family
+    and ``panel_spread``); elsewhere the request degrades to the
+    accuracy-SAFER ``'bf16'`` cast, which every pair supports."""
+    check_comm_precision(mode)
+    if mode is None:
+        return None
+    if A.grid.size == 1 or not quantizable(A.dtype):
+        return None
+    if A.dist == (STAR, STAR):
+        return None                  # replicated source: pure local filter
+    if mode == "int8":
+        return "int8" if q8_ok else "bf16"
+    return "bf16"
+
+
+def _q8_gather_blocks(x, axes, tile: int):
+    """all_gather whole per-device blocks at int8 wire precision: pack
+    (payload + bitcast scales, one array), ONE collective, per-source
+    decode.  Returns the ``(S, *x.shape)`` stack the interleave math of
+    the full-precision kernels consumes unchanged."""
+    packed = q8_pack(x, tile)
+    gx = lax.all_gather(packed, axes, axis=0)
+    return jax.vmap(lambda b: q8_unpack(b, x.shape, x.dtype, tile))(gx)
+
+
+def _gather_dim_q8(x, dim: int, d: Dist, extent: int, r: int, c: int,
+                   tile: int):
+    """Zero-aligned :func:`_gather_dim` with an int8 block-scaled wire."""
+    S = dist_stride(d, r, c)
+    if S == 1:
+        return lax.slice_in_dim(x, 0, extent, axis=dim)
+    g = _q8_gather_blocks(x, gather_axes(d), tile)
+    g = jnp.moveaxis(g, 0, dim + 1)
+    shape = list(x.shape)
+    shape[dim] = x.shape[dim] * S
+    g = g.reshape(shape)
+    return lax.slice_in_dim(g, 0, extent, axis=dim)
+
+
+def _to_star_star_q8(A: DistMatrix, tile: int) -> DistMatrix:
+    """:func:`to_star_star` at int8 wire precision -- same collective
+    rounds (the fused 2-D gather when available, per-dim otherwise),
+    ~4x fewer bytes on the wire."""
+    g = A.grid
+    r, c = g.height, g.width
+    m, n = A.gshape
+    x = A.local
+    if A.dist in ((MC, MR), (MR, MC)) and r > 1 and c > 1:
+        lr, lc = x.shape
+        G = _q8_gather_blocks(x, ("mc", "mr"), tile).reshape(r, c, lr, lc)
+        if A.dist == (MC, MR):
+            full = G.transpose(2, 0, 3, 1).reshape(lr * r, lc * c)
+        else:
+            full = G.transpose(2, 1, 3, 0).reshape(lr * c, lc * r)
+        full = lax.slice(full, (0, 0), (m, n))
+        return DistMatrix(full, A.gshape, STAR, STAR, 0, 0, g)
+    xg = _gather_dim_q8(x, 0, A.cdist, m, r, c, tile)
+    xg = _gather_dim_q8(xg, 1, A.rdist, n, r, c, tile)
+    return DistMatrix(xg, A.gshape, STAR, STAR, 0, 0, g)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _redistribute_q8_jit(A: DistMatrix, tile: int) -> DistMatrix:
+    out_meta = DistMatrix(None, A.gshape, STAR, STAR, 0, 0, A.grid)
+
+    def f(a):
+        return _to_star_star_q8(a, tile)
+
+    return shard_map(
+        f, mesh=A.grid.mesh, in_specs=(A.spec,), out_specs=out_meta.spec,
+        check_vma=False,
+    )(A)
+
+
+# ---------------------------------------------------------------------
 # fused panel spread ([VC,STAR] -> the [MC,STAR]/[STAR,MR] operand pair)
 # ---------------------------------------------------------------------
 
@@ -708,15 +812,40 @@ def _panel_spread_to_pair(A: DistMatrix, conj: bool):
     return mc, mr
 
 
-@partial(jax.jit, static_argnums=(1,))
-def _panel_spread_jit(A: DistMatrix, conj: bool):
+def _panel_spread_to_pair_q8(A: DistMatrix, conj: bool, tile: int):
+    """:func:`_panel_spread_to_pair` at int8 wire precision: the one
+    all_gather moves the packed block-scaled panel, both outputs decode
+    locally -- same single collective round."""
+    g = A.grid
+    r, c = g.height, g.width
+    m, k = A.gshape
+    full = _gather_dim_q8(A.local, 0, VC, m, r, c, tile)
+    mc = _from_star_star(full, (m, k), MC, STAR, 0, 0, g)
+    adj = full.T
+    if conj:
+        adj = jnp.conj(adj)
+    mr = _from_star_star(adj, (k, m), STAR, MR, 0, 0, g)
+    return mc, mr
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _panel_spread_jit(A: DistMatrix, conj: bool, wire=None):
     g = A.grid
     m, k = A.gshape
+    dt = A.dtype
     mc_meta = DistMatrix(None, (m, k), MC, STAR, 0, 0, g)
     mr_meta = DistMatrix(None, (k, m), STAR, MR, 0, 0, g)
 
     def f(a):
-        return _panel_spread_to_pair(a, conj)
+        if wire == "int8":
+            return _panel_spread_to_pair_q8(a, conj, QUANT_TILE)
+        if wire == "bf16":
+            a = a.with_local(a.local.astype(jnp.bfloat16))
+        mc, mr = _panel_spread_to_pair(a, conj)
+        if wire == "bf16":
+            mc = mc.with_local(mc.local.astype(dt))
+            mr = mr.with_local(mr.local.astype(dt))
+        return mc, mr
 
     return shard_map(
         f, mesh=g.mesh, in_specs=(A.spec,),
@@ -724,7 +853,7 @@ def _panel_spread_jit(A: DistMatrix, conj: bool):
     )(A)
 
 
-def panel_spread(A: DistMatrix, conj: bool = True):
+def panel_spread(A: DistMatrix, conj: bool = True, comm_precision=None):
     """``(A -> [MC,STAR],  op(A)^T -> [STAR,MR])`` for a zero-aligned
     [VC,STAR] panel, fused into a single collective round.
 
@@ -732,19 +861,28 @@ def panel_spread(A: DistMatrix, conj: bool = True):
     update and ``herk``/``her2k``'s per-panel chain all need exactly this
     operand pair for the ``LocalTrrk`` storage matmul.  ``conj=True``
     (default) produces the conjugate-transposed adjoint (``A^H``);
-    ``conj=False`` the plain transpose (the ``syrk`` form)."""
+    ``conj=False`` the plain transpose (the ``syrk`` form).
+
+    ``comm_precision`` (``None`` | ``'bf16'`` | ``'int8'``) selects the
+    wire precision of the one collective (see :mod:`.quantize` and
+    :func:`redistribute`): the panel is encoded narrow, gathered, and
+    decoded back to its compute dtype on every device -- 2x/4x fewer
+    bytes at the same round count.  ``None`` (default) is the
+    bit-identical full-precision path."""
     if A.dist != (VC, STAR) or (A.calign, A.ralign) != (0, 0):
         raise ValueError(f"panel_spread needs a zero-aligned [VC,STAR] "
                          f"panel, got {A}")
     REDIST_COUNTS["panel_spread"] += 1
-    mc, mr = _panel_spread_jit(A, conj)
+    wire = _wire_mode(A, comm_precision, q8_ok=True)
+    mc, mr = _panel_spread_jit(A, conj, wire)
     if _FAULT_INJECTOR is not None:
         lmc, lmr = _FAULT_INJECTOR.apply("panel_spread",
                                          (mc.local, mr.local))
         mc, mr = mc.with_local(lmc), mr.with_local(lmr)
     _trace_record("panel_spread", A.dist, ((MC, STAR), (STAR, MR)),
                   A.gshape, A.dtype, A.local, (mc.local, mr.local),
-                  grid_shape=(A.grid.height, A.grid.width))
+                  grid_shape=(A.grid.height, A.grid.width),
+                  wire_dtype=_WIRE_DTYPES.get(wire))
     return mc, mr
 
 
@@ -888,7 +1026,8 @@ def _scatter_sum_dim(x, dim: int, axis_name: str, S: int, l_out: int):
 # ---------------------------------------------------------------------
 
 def redistribute(A: DistMatrix, cdist: Dist, rdist: Dist,
-                 calign: int = 0, ralign: int = 0) -> DistMatrix:
+                 calign: int = 0, ralign: int = 0,
+                 comm_precision=None) -> DistMatrix:
     """B[cdist,rdist] = A, as a standalone (jit-able) op on storage-form
     DistMatrix.  ``Copy(A, B)`` / ``operator=`` of the reference.
 
@@ -896,21 +1035,44 @@ def redistribute(A: DistMatrix, cdist: Dist, rdist: Dist,
     blocked loops run outside an enclosing jit) hit the compile cache instead
     of re-tracing a fresh ``shard_map`` closure per call.
 
+    ``comm_precision`` (``None`` | ``'bf16'`` | ``'int8'``) opts this
+    entry into a narrow wire encoding (:mod:`.quantize`): the payload is
+    encoded inside the jitted shard_map, the collectives move the narrow
+    dtype (the comm-plan analyzer sees the true wire bytes), and the
+    result decodes back to the source dtype.  ``'bf16'`` applies to every
+    pair; ``'int8'`` (block-scaled, packed scales, round-identical) has a
+    fused kernel for the zero-aligned gather-to-[STAR,STAR] family and
+    degrades to ``'bf16'`` elsewhere.  ``None`` (default) is the
+    bit-identical full-precision path; the knob is a no-op on 1x1 grids,
+    non-real-float payloads, and replicated sources (pure-local filters).
+
     CIRC conversions (root-only storage) run EAGERLY at this edge via the
     global bridges plus cross-device ``device_put`` (copy::Gather /
     copy::Scatter) -- they cannot live inside jit/shard_map."""
     _check_pair(cdist, rdist)
     REDIST_COUNTS[(A.dist, (cdist, rdist))] += 1
     if cdist is CIRC or A.cdist is CIRC:
+        check_comm_precision(comm_precision)
+        wire = None
         out = _redistribute_circ(A, cdist, rdist, calign, ralign)
     else:
-        out = _redistribute_jit(A, cdist, rdist, calign, ralign)
+        q8_ok = ((cdist, rdist) == (STAR, STAR)
+                 and (calign, ralign) == (0, 0) and _zero_aligned(A)
+                 and set(A.dist) <= _Q8_DISTS)
+        noop = A.dist == (cdist, rdist) \
+            and (A.calign, A.ralign) == (calign, ralign)
+        wire = None if noop else _wire_mode(A, comm_precision, q8_ok)
+        if wire == "int8":
+            out = _redistribute_q8_jit(A, QUANT_TILE)
+        else:
+            out = _redistribute_jit(A, cdist, rdist, calign, ralign, wire)
     if _FAULT_INJECTOR is not None:
         out = out.with_local(
             _FAULT_INJECTOR.apply("redistribute", (out.local,))[0])
     _trace_record("redistribute", A.dist, (cdist, rdist), A.gshape,
                   A.dtype, A.local, (out.local,),
-                  grid_shape=(A.grid.height, A.grid.width))
+                  grid_shape=(A.grid.height, A.grid.width),
+                  wire_dtype=_WIRE_DTYPES.get(wire))
     return out
 
 
@@ -932,13 +1094,23 @@ def _redistribute_circ(A: DistMatrix, cdist: Dist, rdist: Dist,
                        calign=calign, ralign=ralign)
 
 
-@partial(jax.jit, static_argnums=(1, 2, 3, 4))
+@partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
 def _redistribute_jit(A: DistMatrix, cdist: Dist, rdist: Dist,
-                      calign: int, ralign: int) -> DistMatrix:
+                      calign: int, ralign: int, wire=None) -> DistMatrix:
     out_meta = DistMatrix(None, A.gshape, cdist, rdist, calign, ralign, A.grid)
+    dt = A.dtype
 
     def f(a):
-        return to_dist(a, cdist, rdist, calign, ralign)
+        # bf16 wire: the cast sits INSIDE the traced program, so every
+        # collective of the chain moves bfloat16 (half the bytes) and the
+        # jaxpr-level analyzer reads the true payload dtype off the
+        # collective operand
+        if wire == "bf16":
+            a = a.with_local(a.local.astype(jnp.bfloat16))
+        out = to_dist(a, cdist, rdist, calign, ralign)
+        if wire == "bf16":
+            out = out.with_local(out.local.astype(dt))
+        return out
 
     return shard_map(
         f, mesh=A.grid.mesh, in_specs=(A.spec,), out_specs=out_meta.spec,

@@ -1,0 +1,132 @@
+"""Block-scaled wire codecs for the quantized-collective path (ISSUE 8).
+
+The EQuARX direction (PAPERS.md, arXiv 2506.17615): collectives inside a
+distributed factorization are bandwidth-bound, and a block-quantized
+payload moves 2-4x fewer bytes at negligible quality loss -- provided the
+compute on either side stays full precision and an outer residual
+certificate (``resilience.certified_solve``) guards the result.  This
+module holds the pure per-device codec; the engine
+(:mod:`.engine`) decides WHERE it runs (encode before the collective,
+decode on the far side).
+
+Two wire modes (the ``comm_precision`` knob vocabulary):
+
+``'bf16'``
+    a plain cast: 2x fewer bytes, ~3 decimal digits of mantissa.  Applied
+    around any redistribution pair (the cast happens inside the engine's
+    jitted shard_map, so the collective operand in the traced program IS
+    bfloat16 -- the comm-plan analyzer and cost model see the true wire
+    bytes).
+
+``'int8'``
+    block-scaled integer quantization: per :data:`QUANT_TILE`-sized local
+    tile, ``scale = amax / 127`` and ``q = round(x / scale)`` -- ~4x fewer
+    bytes at ~``amax_tile / 127`` absolute error per element (the
+    documented bound, pinned by ``tests/core/test_comm_precision.py``).
+    The f32 scales are BITCAST-PACKED into extra int8 rows of the payload
+    (:func:`q8_pack`), so the whole encoded shard still travels in ONE
+    collective -- round counts stay identical to the unquantized schedule.
+
+Non-finite contract: NaN/Inf inputs are NEVER masked to finite values.
+The per-tile ``amax`` of a tile containing a non-finite entry is itself
+non-finite, so the tile's scale -- and therefore every decoded element of
+that tile -- is non-finite: the resilience health guards still see the
+corruption (tile-granular, not element-exact).
+
+Scope: the codec applies to real float32/float64 payloads.  Complex,
+integer, and already-narrow dtypes pass through at full precision (the
+engine's ``_wire_mode`` gate).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+#: legal values of the ``comm_precision`` knob (``None`` = full precision,
+#: the bit-identical zero-overhead path)
+COMM_PRECISIONS = (None, "bf16", "int8")
+
+#: side of the square local tiles the int8 scales are computed over.  64
+#: divides every grain-aligned nb the blocked drivers use (the NB_LADDER
+#: floor), so panels tile evenly; scales add ~4/64^2 relative bytes.
+QUANT_TILE = 64
+
+
+def check_comm_precision(mode) -> None:
+    """Raise ValueError on an illegal ``comm_precision`` value."""
+    if mode not in COMM_PRECISIONS:
+        raise ValueError(
+            f"comm_precision must be one of {COMM_PRECISIONS}, got {mode!r}")
+
+
+def quantizable(dtype) -> bool:
+    """True when the codec applies: real float32/float64 payloads."""
+    return jnp.dtype(dtype) in (jnp.dtype(jnp.float32),
+                                jnp.dtype(jnp.float64))
+
+
+def _tile_counts(shape, tile: int):
+    lr, lc = shape
+    return -(-lr // tile), -(-lc // tile)
+
+
+def q8_encode(x, tile: int = QUANT_TILE):
+    """Block-scaled int8 quantization of a 2-D block.
+
+    Returns ``(q, scales)``: ``q`` int8 with ``x``'s shape, ``scales``
+    float32 of shape ``(ceil(lr/tile), ceil(lc/tile))``.  Zero tiles get
+    scale 1 (exact zeros round-trip); non-finite tiles get a non-finite
+    scale (see module docstring)."""
+    lr, lc = x.shape
+    tr, tc = _tile_counts(x.shape, tile)
+    xp = jnp.pad(x, ((0, tr * tile - lr), (0, tc * tile - lc)))
+    xb = xp.reshape(tr, tile, tc, tile)
+    amax = jnp.max(jnp.abs(xb), axis=(1, 3)).astype(jnp.float32)
+    # keep NaN/Inf amax (NaN == 0 is False): the scale must stay
+    # non-finite so decode cannot mask a corrupted tile
+    scale = jnp.where(amax == 0, jnp.float32(1), amax) / jnp.float32(127)
+    q = jnp.round(xb / scale[:, None, :, None].astype(x.dtype))
+    q = jnp.clip(q, -127, 127).astype(jnp.int8)
+    return q.reshape(tr * tile, tc * tile)[:lr, :lc], scale
+
+
+def q8_decode(q, scales, dtype, tile: int = QUANT_TILE):
+    """Inverse of :func:`q8_encode` (up to the documented error bound)."""
+    lr, lc = q.shape
+    tr, tc = _tile_counts(q.shape, tile)
+    qp = jnp.pad(q, ((0, tr * tile - lr), (0, tc * tile - lc)))
+    qb = qp.reshape(tr, tile, tc, tile).astype(jnp.float32)
+    xb = qb * scales[:, None, :, None]
+    return xb.reshape(tr * tile, tc * tile)[:lr, :lc].astype(dtype)
+
+
+def q8_packed_rows(shape, tile: int = QUANT_TILE) -> int:
+    """Rows of a :func:`q8_pack` payload for a ``shape`` block (static)."""
+    lr, lc = shape
+    tr, tc = _tile_counts(shape, tile)
+    return lr + -(-tr * tc * 4 // lc)
+
+
+def q8_pack(x, tile: int = QUANT_TILE):
+    """Encode + pack one block into a single int8 wire array.
+
+    The f32 scales are bitcast to int8 and appended as whole extra rows
+    below the payload, so the encoded shard travels through the SAME
+    collective as the data (whole local blocks move intact in every
+    engine gather kernel) -- one round, ~4x fewer bytes."""
+    lr, lc = x.shape
+    q, scales = q8_encode(x, tile)
+    sraw = lax.bitcast_convert_type(scales.reshape(-1), jnp.int8).reshape(-1)
+    srows = -(-sraw.shape[0] // lc)
+    sraw = jnp.pad(sraw, (0, srows * lc - sraw.shape[0]))
+    return jnp.concatenate([q, sraw.reshape(srows, lc)], axis=0)
+
+
+def q8_unpack(packed, shape, dtype, tile: int = QUANT_TILE):
+    """Inverse of :func:`q8_pack`: split payload/scales, decode."""
+    lr, lc = shape
+    tr, tc = _tile_counts(shape, tile)
+    q = packed[:lr]
+    sraw = packed[lr:].reshape(-1)[: tr * tc * 4].reshape(tr * tc, 4)
+    scales = lax.bitcast_convert_type(sraw, jnp.float32).reshape(tr, tc)
+    return q8_decode(q, scales, dtype, tile)

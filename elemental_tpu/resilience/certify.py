@@ -12,19 +12,27 @@ ladder is exhausted.
 
 The ladder (order pinned by ``tests/resilience``)::
 
+    quant    wire-quantized fast configuration (ISSUE 8): the same
+             speed-first knobs as 'fast' PLUS ``comm_precision='int8'``
+             -- block-scaled int8/bf16 payloads on every bulk collective,
+             2-4x fewer bytes on the wire -- with a refinement budget
+             (8 iterations) sized so the ~1e-2 quantized-factor error
+             refines down to fp64-class tolerances on well-conditioned
+             systems.  On 1x1 grids the knob is a no-op (bit-identical
+             to 'fast').
     fast     speed-first factorization: CALU tournament panel (lu) /
-             default-precision trailing updates, small refinement budget
+             default-precision trailing updates, full-precision wire
     refine   SAME factor, larger iterative-refinement budget (cheapest
              escalation: no refactorization)
     fp32     refactor with full-precision trailing updates
     classic  refactor with the classic (partial-pivot / classic-schedule)
              panel -- the maximum-stability baseline
 
-This is the residual certificate the ROADMAP's quantized-collectives
-item (EQuARX, arXiv 2506.17615) requires before aggressive
-``comm_precision`` can ship: any future rung that cheapens communication
-slots in ABOVE ``fast`` and inherits the same certify-or-escalate
-contract.
+This closes the loop the ROADMAP's quantized-collectives item (EQuARX,
+arXiv 2506.17615) planned: aggressive ``comm_precision`` runs FIRST, the
+trusted residual certificate decides whether its answer stands, and any
+failure escalates to full-precision wire -- zero silent accuracy loss by
+construction.
 
 Trust boundary: the certificate's residual is computed HOST-SIDE in
 float64 from ``to_global`` snapshots (pure storage gathers -- no engine
@@ -45,7 +53,11 @@ certificate carries the health report naming the failing phase.
      "ladder": ["fast", "refine", "fp32", "classic"],
      "attempts": [{"rung", "residual", "refine_iters", "singular",
                    "diag_index", "health"}, ...],
-     "singular": false,              # every attempted factor was singular
+     "singular": false,              # every FULL-WIRE attempt was singular
+                                     #   (a wire-quantized factorization
+                                     #   perturbs exact zeros off the
+                                     #   diagonal, so quant rungs cannot
+                                     #   attest singularity either way)
      "failing_phase": null,          # first health-flagged phase /
                                      #   "diag" (singular) / "residual"
      "health": {...}}                # last attempt's health_report/v1
@@ -68,7 +80,7 @@ CERT_SCHEMA = "solve_certificate/v1"
 TOL_FACTOR = 64.0
 
 #: canonical ladder rung names, in escalation order (pinned by tests)
-LADDER_NAMES = ("fast", "refine", "fp32", "classic")
+LADDER_NAMES = ("quant", "fast", "refine", "fp32", "classic")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -82,16 +94,21 @@ class Rung:
 
 def default_ladder(op: str):
     """The documented ladder for ``op`` ('lu' | 'hpd').  Rung configs are
-    knob dicts in the tuner's vocabulary (``tune.knobs``): 'fast' rides
-    the ISSUE-6 CALU panel (``LU_PANELS[1]``; degenerates to classic on
-    single-row grids inside the driver) with default-precision trailing
-    updates, 'classic' is ``LU_PANELS[0]`` / the classic schedule."""
+    knob dicts in the tuner's vocabulary (``tune.knobs``): 'quant' is the
+    ISSUE-8 wire-quantized rung ('fast' + ``comm_precision='int8'``,
+    ``COMM_PRECISIONS[2]``), 'fast' rides the ISSUE-6 CALU panel
+    (``LU_PANELS[1]``; degenerates to classic on single-row grids inside
+    the driver) with default-precision trailing updates, 'classic' is
+    ``LU_PANELS[0]`` / the classic schedule."""
     from jax import lax
+    from ..tune.knobs import COMM_PRECISIONS
+    q8 = COMM_PRECISIONS[2]                      # 'int8'
     if op == "lu":
         from ..tune.knobs import LU_PANELS
         classic, calu = LU_PANELS
         fast = {"panel": calu, "update_precision": lax.Precision.DEFAULT}
         return (
+            Rung("quant", {**fast, "comm_precision": q8}, refine=8),
             Rung("fast", fast, refine=2),
             Rung("refine", fast, refine=8, refactor=False),
             Rung("fp32", {"panel": calu, "update_precision": None},
@@ -102,6 +119,7 @@ def default_ladder(op: str):
     if op == "hpd":
         fast = {"precision": None}
         return (
+            Rung("quant", {**fast, "comm_precision": q8}, refine=8),
             Rung("fast", fast, refine=2),
             Rung("refine", fast, refine=8, refactor=False),
             Rung("fp32", {"precision": lax.Precision.HIGHEST}, refine=4),
@@ -241,10 +259,15 @@ def certified_solve(op: str, A, B, *, tol: float | None = None,
                                    rungs, attempts)
     last = attempts[-1] if attempts else None
     res = last["residual"] if last else None
-    return X, _certificate(op, False, None,
-                           res if res is not None else float("nan"),
-                           tol, last["refine_iters"] if last else 0,
-                           rungs, attempts)
+    cert = _certificate(op, False, None,
+                        res if res is not None else float("nan"),
+                        tol, last["refine_iters"] if last else 0,
+                        rungs, attempts)
+    if cert["singular"]:
+        # the only solves produced (if any) came from wire-quantized
+        # factors of an attested-singular system: suppress the garbage
+        X = None
+    return X, cert
 
 
 def _failing_phase(attempts) -> str | None:
@@ -265,6 +288,12 @@ def _certificate(op, certified, rung, residual, tol, iters, rungs,
         if att.get("health") is not None:
             last_health = att["health"]
             break
+    # singularity is attested by the rungs that factored at FULL wire
+    # precision: a comm_precision rung's quantization perturbs an exactly
+    # zero pivot into a small nonzero one, so its diag verdict is
+    # inconclusive in both directions
+    attested = [a for a, r in zip(attempts, rungs)
+                if not r.config.get("comm_precision")]
     return {"schema": CERT_SCHEMA, "op": op, "certified": bool(certified),
             "rung": rung,
             "residual": None if residual is None or not np.isfinite(residual)
@@ -272,7 +301,7 @@ def _certificate(op, certified, rung, residual, tol, iters, rungs,
             "tol": float(tol), "refine_iters": int(iters),
             "ladder": [r.name for r in rungs],
             "attempts": attempts,
-            "singular": bool(attempts) and all(a["singular"]
-                                               for a in attempts),
+            "singular": bool(attested) and all(a["singular"]
+                                               for a in attested),
             "failing_phase": None if certified else _failing_phase(attempts),
             "health": last_health}

@@ -52,6 +52,20 @@ _MAX_TRACE_STEPS = 6
 HALF_NB = 512.0
 IMB = 3.0
 
+#: the quantized-collective term (ISSUE 8): wire-byte scaling per
+#: ``comm_precision`` mode.  bf16 is exactly half; int8 blends the ~4x
+#: block-scaled gather family with the bf16-degraded pairs and the packed
+#: scale rows, so 0.3 is the modeled blend (the traced *_commq golden
+#: plans pin the exact per-driver ratios).
+WIRE_FACTORS = {"bf16": 0.5, "int8": 0.3}
+
+#: encode+decode vector passes over the LOGICAL payload per mode: bf16 is
+#: one cast on each side; int8 adds the tile-amax reduction and the
+#: scale multiply.  Priced against ``MachineModel.decode_bw_bytes_per_s``
+#: so tiny latency-bound problems keep ``None`` (the candidate-order
+#: tie-break) while bandwidth-bound geometries buy the narrower wire.
+DECODE_PASSES = {"bf16": 2.0, "int8": 4.0}
+
 
 @dataclasses.dataclass(frozen=True)
 class MachineModel:
@@ -60,6 +74,9 @@ class MachineModel:
     latency_s: float           # per collective round (dispatch + hop)
     bw_bytes_per_s: float      # per-device collective bandwidth
     peak_flops: float          # per-device fp32-class matmul peak
+    #: vector-unit (HBM-stream) bandwidth pricing the quantize/dequantize
+    #: passes of the comm_precision path -- roughly 10x the wire
+    decode_bw_bytes_per_s: float = 4.0e11
 
 
 MACHINES = {
@@ -84,21 +101,22 @@ class CostBreakdown:
     latency_s: float
     bandwidth_s: float
     rounds: float              # extrapolated collective rounds
-    comm_bytes: float          # extrapolated ring-model bytes per device
+    comm_bytes: float          # extrapolated ring-model WIRE bytes/device
     prim_counts: dict          # per-collective counts AT TRACE GEOMETRY
     detail: dict               # trace geometry / closed-form site notes
     pivot_s: float = 0.0       # pivot/reflector serial-chain latency
+    decode_s: float = 0.0      # comm_precision encode/decode passes
 
     @property
     def total_s(self) -> float:
         return self.compute_s + self.latency_s + self.bandwidth_s \
-            + self.pivot_s
+            + self.pivot_s + self.decode_s
 
     def to_doc(self) -> dict:
         return {"config": dict(self.config),
                 "total_s": self.total_s, "compute_s": self.compute_s,
                 "latency_s": self.latency_s, "bandwidth_s": self.bandwidth_s,
-                "pivot_s": self.pivot_s,
+                "pivot_s": self.pivot_s, "decode_s": self.decode_s,
                 "rounds": self.rounds, "comm_bytes": self.comm_bytes,
                 "prim_counts": dict(self.prim_counts),
                 "detail": dict(self.detail)}
@@ -296,27 +314,43 @@ def _trace_stats(op: str, dims_t, nb_t: int, la, xo_t, grid, dtype,
     return stats
 
 
+def _wire_terms(cbytes: float, comm_precision, machine: MachineModel):
+    """(wire bytes, decode seconds) of the comm_precision term: the
+    bytes-on-wire shrink by the mode's factor while an encode/decode
+    vector pass over the LOGICAL payload is added on each side."""
+    if not comm_precision:
+        return cbytes, 0.0
+    wire = cbytes * WIRE_FACTORS.get(comm_precision, 1.0)
+    decode = DECODE_PASSES.get(comm_precision, 0.0) * cbytes \
+        / machine.decode_bw_bytes_per_s
+    return wire, decode
+
+
 def _traced_cost(op: str, config: dict, ctx: TuneContext, grid, dtype,
                  machine: MachineModel) -> CostBreakdown:
     la = config.get("lookahead", True)
     xo = config.get("crossover")
     nb = config.get("nb")
     panel = config.get("panel") or "classic"
+    cpm = config.get("comm_precision")
     dims_t, nb_t, xo_t, lat_scale, byte_scale = _geometry(ctx, nb, xo, la)
     stats = _trace_stats(op, dims_t, nb_t, la, xo_t, grid, dtype, panel)
     rounds = stats["rounds"] * lat_scale
     cbytes = stats["bytes"] * byte_scale
+    wire_bytes, decode_s = _wire_terms(cbytes, cpm, machine)
     return CostBreakdown(
         config=dict(config),
         compute_s=_compute_seconds(op, ctx, nb, machine),
         latency_s=machine.latency_s * rounds,
-        bandwidth_s=cbytes / machine.bw_bytes_per_s,
+        bandwidth_s=wire_bytes / machine.bw_bytes_per_s,
         pivot_s=_pivot_seconds(op, ctx, config, machine),
-        rounds=rounds, comm_bytes=cbytes,
+        decode_s=decode_s,
+        rounds=rounds, comm_bytes=wire_bytes,
         prim_counts={k: t["count"] for k, t in stats["totals"].items()},
         detail={"trace_dims": list(dims_t), "trace_nb": nb_t,
                 "trace_crossover": xo_t, "lat_scale": round(lat_scale, 3),
-                "byte_scale": round(byte_scale, 3), "panel": panel})
+                "byte_scale": round(byte_scale, 3), "panel": panel,
+                "comm_precision": cpm})
 
 
 # ---------------------------------------------------------------------
@@ -388,21 +422,31 @@ def _gemm_cost(config: dict, ctx: TuneContext, itemsize: int,
     r, c = ctx.grid_shape
     alg = config["alg"]
     nb = config.get("nb")
+    cpm = config.get("comm_precision")
     sites, rounds, cbytes = _gemm_sites(alg, m, k, n, r, c, nb, itemsize,
                                         ctx.grain)
     counts: dict = {}
     for _, prim, b in sites:
         if b > 0:
             counts[prim] = counts.get(prim, 0) + 1
+    # the engine quantizes the redistribution gathers; GSPMD-inserted
+    # contraction psums stay full precision (gemm's non-SS pairs all
+    # degrade int8 -> bf16, so both modes price at the bf16 factor)
+    ag_bytes = sum(b for _, p, b in sites if p == "all_gather")
+    wire_ag, decode_s = _wire_terms(ag_bytes,
+                                    "bf16" if cpm else None, machine)
+    wire_bytes = (cbytes - ag_bytes) + wire_ag
     return CostBreakdown(
         config=dict(config),
         compute_s=_compute_seconds("gemm", ctx, nb, machine,
                                    nb_sensitive=alg in ("A", "B", "C")),
         latency_s=machine.latency_s * rounds,
-        bandwidth_s=cbytes / machine.bw_bytes_per_s,
-        rounds=rounds, comm_bytes=cbytes, prim_counts=counts,
+        bandwidth_s=wire_bytes / machine.bw_bytes_per_s,
+        decode_s=decode_s,
+        rounds=rounds, comm_bytes=wire_bytes, prim_counts=counts,
         detail={"sites": [{"site": t, "prim": p, "bytes": b}
-                          for t, p, b in sites]})
+                          for t, p, b in sites],
+                "comm_precision": cpm})
 
 
 # ---------------------------------------------------------------------

@@ -100,6 +100,31 @@ def _nb_only_space(ctx: TuneContext, pinned: dict) -> list:
     return [{"nb": nb} for nb in nbs]
 
 
+#: wire-precision modes of the quantized-collective path (ISSUE 8, the
+#: EQuARX direction): ``None`` = full precision (bit-identical, the
+#: candidate-order tie-break leader), 'bf16' = cast wire (2x fewer
+#: bytes), 'int8' = block-scaled wire (4x on the gather family).  Kept in
+#: sync with ``redist.quantize.COMM_PRECISIONS`` (pinned by tests/tune).
+COMM_PRECISIONS = (None, "bf16", "int8")
+
+
+def _with_comm_precision(space: list, ctx: TuneContext, pinned: dict) -> list:
+    """Cross every candidate with the legal comm_precision values.
+
+    An explicitly pinned value (INCLUDING ``None``, the bit-identical
+    default every driver passes when the user did not opt in) freezes the
+    dimension; otherwise single-device grids enumerate only ``None`` (no
+    collectives execute, so quantization would cost accuracy for zero
+    byte savings) and multi-device grids sweep the full mode set."""
+    if "comm_precision" in pinned:
+        chosen = (pinned["comm_precision"],)
+    elif ctx.grid_size <= 1:
+        chosen = (None,)
+    else:
+        chosen = COMM_PRECISIONS
+    return [{**cfg, "comm_precision": cp} for cfg in space for cp in chosen]
+
+
 #: panel strategies of the pivoted/reflector factorizations (ISSUE 6):
 #: 'classic' = replicated column-at-a-time panel (the stability baseline),
 #: the alternative = communication-avoiding tree panel (CALU tournament
@@ -123,15 +148,27 @@ def _with_panels(space: list, ctx: TuneContext, pinned: dict,
     return out
 
 
+def _cholesky_space(ctx: TuneContext, pinned: dict) -> list:
+    return _with_comm_precision(_factorization_space(ctx, pinned), ctx,
+                                pinned)
+
+
 def _lu_space(ctx: TuneContext, pinned: dict) -> list:
     base = {k: v for k, v in pinned.items() if k != "panel"}
-    return _with_panels(_factorization_space(ctx, base), ctx, pinned,
-                        LU_PANELS)
+    return _with_comm_precision(
+        _with_panels(_factorization_space(ctx, base), ctx, pinned,
+                     LU_PANELS), ctx, pinned)
 
 
 def _qr_space(ctx: TuneContext, pinned: dict) -> list:
     base = {k: v for k, v in pinned.items() if k != "panel"}
-    return _with_panels(_nb_only_space(ctx, base), ctx, pinned, QR_PANELS)
+    return _with_comm_precision(
+        _with_panels(_nb_only_space(ctx, base), ctx, pinned, QR_PANELS),
+        ctx, pinned)
+
+
+def _nb_comm_space(ctx: TuneContext, pinned: dict) -> list:
+    return _with_comm_precision(_nb_only_space(ctx, pinned), ctx, pinned)
 
 
 #: gemm candidate order doubles as the deterministic tie-break: on a 1x1
@@ -153,7 +190,7 @@ def _gemm_space(ctx: TuneContext, pinned: dict) -> list:
             out.append({"alg": alg, "nb": nb})
             if alg in ("dot", "gspmd"):
                 break                     # nb is dead for the one-shot algs
-    return out
+    return _with_comm_precision(out, ctx, pinned)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -165,14 +202,15 @@ class OpSpace:
 
 
 OPS = {
-    "cholesky": OpSpace("cholesky", ("nb", "lookahead", "crossover"),
-                        _factorization_space),
-    "lu": OpSpace("lu", ("nb", "lookahead", "crossover", "panel"),
-                  _lu_space),
-    "qr": OpSpace("qr", ("nb", "panel"), _qr_space),
-    "gemm": OpSpace("gemm", ("alg", "nb"), _gemm_space),
-    "trsm": OpSpace("trsm", ("nb",), _nb_only_space),
-    "herk": OpSpace("herk", ("nb",), _nb_only_space),
+    "cholesky": OpSpace("cholesky",
+                        ("nb", "lookahead", "crossover", "comm_precision"),
+                        _cholesky_space),
+    "lu": OpSpace("lu", ("nb", "lookahead", "crossover", "panel",
+                         "comm_precision"), _lu_space),
+    "qr": OpSpace("qr", ("nb", "panel", "comm_precision"), _qr_space),
+    "gemm": OpSpace("gemm", ("alg", "nb", "comm_precision"), _gemm_space),
+    "trsm": OpSpace("trsm", ("nb", "comm_precision"), _nb_comm_space),
+    "herk": OpSpace("herk", ("nb", "comm_precision"), _nb_comm_space),
 }
 
 

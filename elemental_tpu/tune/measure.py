@@ -91,7 +91,9 @@ def _builders(op: str, dims, grid, dtype):
         def factory(cfg):
             return jax.jit(lambda a: el.cholesky(
                 a, nb=cfg.get("nb"), lookahead=cfg.get("lookahead", True),
-                crossover=cfg.get("crossover"), precision=HI).local,
+                crossover=cfg.get("crossover"),
+                comm_precision=cfg.get("comm_precision"),
+                precision=HI).local,
                 donate_argnums=0)
         return make, factory
     if op == "lu":
@@ -105,7 +107,9 @@ def _builders(op: str, dims, grid, dtype):
         def factory(cfg):
             return jax.jit(lambda a: tuple(el.lu(
                 a, nb=cfg.get("nb"), lookahead=cfg.get("lookahead", True),
-                crossover=cfg.get("crossover"), precision=HI)),
+                crossover=cfg.get("crossover"),
+                panel=cfg.get("panel") or "classic",
+                comm_precision=cfg.get("comm_precision"), precision=HI)),
                 donate_argnums=0)
         return make, factory
     if op == "qr":
@@ -117,9 +121,10 @@ def _builders(op: str, dims, grid, dtype):
             return dm(gen(), m, n)
 
         def factory(cfg):
-            return jax.jit(lambda a: tuple(el.qr(a, nb=cfg.get("nb"),
-                                                 precision=HI)),
-                           donate_argnums=0)
+            return jax.jit(lambda a: tuple(el.qr(
+                a, nb=cfg.get("nb"), panel=cfg.get("panel") or "classic",
+                comm_precision=cfg.get("comm_precision"), precision=HI)),
+                donate_argnums=0)
         return make, factory
     if op == "trsm":
         m, n = dims[0], dims[-1]
@@ -136,10 +141,11 @@ def _builders(op: str, dims, grid, dtype):
             return (dm(a, m, m), dm(b, m, n))
 
         def factory(cfg):
-            return jax.jit(lambda ab: el.trsm("L", "L", "N", ab[0], ab[1],
-                                              nb=cfg.get("nb"),
-                                              precision=HI).local,
-                           donate_argnums=0)
+            return jax.jit(lambda ab: el.trsm(
+                "L", "L", "N", ab[0], ab[1], nb=cfg.get("nb"),
+                comm_precision=cfg.get("comm_precision"),
+                precision=HI).local,
+                donate_argnums=0)
         return make, factory
     if op == "herk":
         m, k = dims[0], dims[-1]
@@ -150,9 +156,11 @@ def _builders(op: str, dims, grid, dtype):
             return dm(gen(), m, k)
 
         def factory(cfg):
-            return jax.jit(lambda a: el.herk("L", a, nb=cfg.get("nb"),
-                                             precision=HI).local,
-                           donate_argnums=0)
+            return jax.jit(lambda a: el.herk(
+                "L", a, nb=cfg.get("nb"),
+                comm_precision=cfg.get("comm_precision"),
+                precision=HI).local,
+                donate_argnums=0)
         return make, factory
     if op == "gemm":
         m, k, n = dims
@@ -168,11 +176,12 @@ def _builders(op: str, dims, grid, dtype):
             return (dm(a, m, k), dm(b, k, n))
 
         def factory(cfg):
-            return jax.jit(lambda ab: el.gemm(ab[0], ab[1],
-                                              alg=cfg.get("alg", "auto"),
-                                              nb=cfg.get("nb"),
-                                              precision=HI).local,
-                           donate_argnums=0)
+            return jax.jit(lambda ab: el.gemm(
+                ab[0], ab[1], alg=cfg.get("alg", "auto"),
+                nb=cfg.get("nb"),
+                comm_precision=cfg.get("comm_precision"),
+                precision=HI).local,
+                donate_argnums=0)
         return make, factory
     raise KeyError(f"no measurement builder for op {op!r}")
 

@@ -13,6 +13,7 @@ before/after pair).  Usage:
     python perf/ab_harness.py lu-dist [N]   # distributed LU: classic-panel
                                             #   vs CALU tournament panel x
                                             #   look-ahead x tail crossover
+                                            #   x comm_precision wire sweep
                                             #   on ALL visible devices
     python perf/ab_harness.py phases [lu|cholesky] [N NB]
                                             # per-step phase wall-clock as
@@ -30,6 +31,15 @@ distributed schedule without hardware.
 ``phases`` drives ``perf.phase_timer.PhaseTimer`` through the real driver
 (eagerly, sync at each phase boundary) and emits the ``phase_timings/v1``
 JSON -- the hook future perf PRs use to attribute regressions.
+
+``lu-dist`` and ``cholesky`` additionally sweep the ISSUE-8
+``comm_precision`` wire-quantization knob on multi-device grids: each
+quantized row is the exact twin of the headline look-ahead schedule at
+equal nb/crossover/panel, so a row pair is a pure wire-precision A/B
+(and the row prints the factor residual next to the throughput -- the
+accuracy cost of the narrow wire is part of the measurement).  Override
+the swept modes with ``--comm-precision bf16,int8`` (or ``none`` to
+disable).
 """
 import os
 import sys
@@ -218,7 +228,7 @@ def run_lu(n=None):
     lu_mod._INNERS = orig_inners
 
 
-def run_lu_dist(n=None):
+def run_lu_dist(n=None, cps=("bf16", "int8")):
     """ISSUE 3 + 6 A/B: distributed LU classic-panel vs CALU tournament
     panel, each under classic and look-ahead x tail-crossover schedules,
     same process and grid (all visible devices), roofline-bracketed --
@@ -238,33 +248,41 @@ def run_lu_dist(n=None):
     def wrap(a):
         return el.DistMatrix(a, (n, n), el.MC, el.MR, 0, 0, grid)
 
-    # (name, lookahead, nb, crossover, panel)
+    # (name, lookahead, nb, crossover, panel, comm_precision)
     cases = [
-        (f"classic        nb={nb0} xover=0", False, nb0, 0, "classic"),
-        (f"look-ahead     nb={nb0} xover=0", True, nb0, 0, "classic"),
+        (f"classic        nb={nb0} xover=0", False, nb0, 0, "classic", None),
+        (f"look-ahead     nb={nb0} xover=0", True, nb0, 0, "classic", None),
     ]
     if p > 1:
         for xo in (n // 8, n // 4, n // 2):
             cases.append((f"look-ahead     nb={nb0} xover={xo}",
-                          True, nb0, xo, "classic"))
+                          True, nb0, xo, "classic", None))
         cases.append((f"classic        nb={nb0} xover={n // 4}",
-                      False, nb0, n // 4, "classic"))
+                      False, nb0, n // 4, "classic", None))
+        # wire-precision twins of the headline look-ahead row: equal
+        # nb/crossover/panel, so each pair is a pure comm_precision A/B
+        for cp in cps:
+            cases.append((f"look-ahead     nb={nb0} xover=0 wire={cp}",
+                          True, nb0, 0, "classic", cp))
     if grid.height > 1:
         # the calu twins of the headline schedules: equal nb/crossover so
         # every row pair is a pure panel-strategy A/B
         cases.append((f"calu           nb={nb0} xover=0",
-                      True, nb0, 0, "calu"))
+                      True, nb0, 0, "calu", None))
         cases.append((f"calu classic-sched nb={nb0} xover=0",
-                      False, nb0, 0, "calu"))
+                      False, nb0, 0, "calu", None))
         for xo in (n // 8, n // 4):
             cases.append((f"calu look-ahead nb={nb0} xover={xo}",
-                          True, nb0, xo, "calu"))
+                          True, nb0, xo, "calu", None))
+        for cp in cps:
+            cases.append((f"calu           nb={nb0} xover=0 wire={cp}",
+                          True, nb0, 0, "calu", cp))
     print(f"grid {grid.height}x{grid.width}, n={n}", flush=True)
-    for name, la, nb, xo, pan in cases:
+    for name, la, nb, xo, pan, cp in cases:
         step = jax.jit(
-            lambda a, _nb=nb, _la=la, _xo=xo, _p=pan: tuple(el.lu(
+            lambda a, _nb=nb, _la=la, _xo=xo, _p=pan, _c=cp: tuple(el.lu(
                 a, nb=_nb, precision=HI, lookahead=_la, crossover=_xo,
-                panel=_p))[0].local,
+                panel=_p, comm_precision=_c))[0].local,
             donate_argnums=0)
         r0 = roofline()
         dt = timed(lambda: wrap(gen()), step)
@@ -273,7 +291,7 @@ def run_lu_dist(n=None):
         del step
 
 
-def run_cholesky(n=None):
+def run_cholesky(n=None, cps=("bf16", "int8")):
     """ISSUE 2 A/B: classic vs look-ahead x nb x tail-crossover, same
     process and grid (all visible devices), roofline-bracketed.  On a
     single device the crossover rows are skipped (the sequential path has
@@ -292,28 +310,55 @@ def run_cholesky(n=None):
     def wrap(a):
         return el.DistMatrix(a, (n, n), el.MC, el.MR, 0, 0, grid)
 
-    # (name, lookahead, nb, crossover)
+    # (name, lookahead, nb, crossover, comm_precision)
     cases = [
-        (f"classic        nb={nb0} xover=0", False, nb0, 0),
-        (f"look-ahead     nb={nb0} xover=0", True, nb0, 0),
-        (f"look-ahead     nb={nb0 // 2} xover=0", True, nb0 // 2, 0),
-        (f"look-ahead     nb={nb0 * 2} xover=0", True, nb0 * 2, 0),
+        (f"classic        nb={nb0} xover=0", False, nb0, 0, None),
+        (f"look-ahead     nb={nb0} xover=0", True, nb0, 0, None),
+        (f"look-ahead     nb={nb0 // 2} xover=0", True, nb0 // 2, 0, None),
+        (f"look-ahead     nb={nb0 * 2} xover=0", True, nb0 * 2, 0, None),
     ]
     if p > 1:
         for xo in (n // 8, n // 4, n // 2):
-            cases.append((f"look-ahead     nb={nb0} xover={xo}", True, nb0, xo))
+            cases.append((f"look-ahead     nb={nb0} xover={xo}", True, nb0,
+                          xo, None))
         cases.append((f"classic        nb={nb0} xover={n // 4}",
-                      False, nb0, n // 4))
+                      False, nb0, n // 4, None))
+        # wire-precision twins of the headline look-ahead row (pure
+        # comm_precision A/B at equal nb/crossover)
+        for cp in cps:
+            cases.append((f"look-ahead     nb={nb0} xover=0 wire={cp}",
+                          True, nb0, 0, cp))
     print(f"grid {grid.height}x{grid.width}, n={n}", flush=True)
-    for name, la, nb, xo in cases:
+    for name, la, nb, xo, cp in cases:
         step = jax.jit(
-            lambda a, _nb=nb, _la=la, _xo=xo: el.cholesky(
-                a, nb=_nb, precision=HI, lookahead=_la, crossover=_xo).local,
+            lambda a, _nb=nb, _la=la, _xo=xo, _c=cp: el.cholesky(
+                a, nb=_nb, precision=HI, lookahead=_la, crossover=_xo,
+                comm_precision=_c).local,
             donate_argnums=0)
         r0 = roofline()
         dt = timed(lambda: wrap(gen()), step)
         r1 = roofline()
-        report(name, (n ** 3 / 3) / dt / 1e12, 0.5 * (r0 + r1))
+        extra = ""
+        if cp is not None:
+            # accuracy cost of the narrow wire, printed inline.  The
+            # timing rows feed gen()'s output as STORAGE (cheap, and
+            # layout-irrelevant for wall-clock); the residual needs the
+            # implied global matrix to really be SPD, so this one run
+            # goes through the from_global/to_global bridges.
+            from elemental_tpu import from_global, to_global
+            a = gen()
+            Ld = el.cholesky(from_global(a, el.MC, el.MR, grid=grid),
+                             nb=nb, precision=HI, lookahead=la,
+                             crossover=xo, comm_precision=cp)
+            lg = to_global(Ld)
+            v = jax.random.normal(jax.random.PRNGKey(2), (n, 1), jnp.float32)
+            r = jnp.matmul(a, v, precision=HI) - jnp.matmul(
+                lg, jnp.matmul(lg.T, v, precision=HI), precision=HI)
+            resid = float(jnp.linalg.norm(r)
+                          / (jnp.linalg.norm(a) * jnp.linalg.norm(v)))
+            extra = f"   resid {resid:.2e}"
+            del Ld, lg, a, v
+        report(name, (n ** 3 / 3) / dt / 1e12, 0.5 * (r0 + r1), extra)
         del step
 
 
@@ -356,7 +401,14 @@ def run_phases(*args):
 
 
 if __name__ == "__main__":
-    mode = sys.argv[1] if len(sys.argv) > 1 else "chol"
+    argv = sys.argv[1:]
+    cps = ("bf16", "int8")
+    if "--comm-precision" in argv:
+        i = argv.index("--comm-precision")
+        raw = argv[i + 1] if i + 1 < len(argv) else "none"
+        del argv[i: i + 2]
+        cps = tuple(c for c in raw.split(",") if c and c != "none")
+    mode = argv[0] if argv else "chol"
     tiny = jax.jit(lambda x: x + 1.0)
     t = jnp.zeros(())
     float(tiny(t))
@@ -367,10 +419,10 @@ if __name__ == "__main__":
     if mode == "chol":
         run_chol()
     elif mode == "lu":
-        run_lu(*sys.argv[2:3])
+        run_lu(*argv[1:2])
     elif mode == "lu-dist":
-        run_lu_dist(*sys.argv[2:3])
+        run_lu_dist(*argv[1:2], cps=cps)
     elif mode == "cholesky":
-        run_cholesky(*sys.argv[2:3])
+        run_cholesky(*argv[1:2], cps=cps)
     else:
-        run_phases(*sys.argv[2:5])
+        run_phases(*argv[1:4])

@@ -1,0 +1,76 @@
+"""Quantized-collective comm plans (ISSUE 8): the jaxpr-level pin of the
+EQuARX win.
+
+The ``*_commq`` registry variants trace the SAME schedules as their
+full-precision twins with ``comm_precision='bf16'``; these tests pin, on
+the 2x2 grid, identical per-collective round counts with >= 1.9x lower
+total estimated wire bytes -- bytes drop because the collective operands
+in the traced program really ARE bfloat16 (payload-dtype-aware byte
+estimates), not because any round disappeared or was re-counted.
+"""
+import jax
+import pytest
+
+from elemental_tpu import Grid
+from elemental_tpu import analysis as an
+
+
+def _grid(r, c):
+    return Grid(jax.devices()[: r * c], height=r)
+
+
+@pytest.mark.parametrize("commq,base", an.COMMQ_PAIRS,
+                         ids=[c for c, _ in an.COMMQ_PAIRS])
+def test_commq_byte_drop_at_identical_rounds(commq, base):
+    g = _grid(2, 2)
+    plan_q, _, _ = an.trace_driver(commq, g)
+    plan_b, _, _ = an.trace_driver(base, g)
+    tq, tb = plan_q.totals(), plan_b.totals()
+    # identical collective schedule: same primitives, same round counts
+    assert {k: v["count"] for k, v in tq.items()} \
+        == {k: v["count"] for k, v in tb.items()}, (tq, tb)
+    # and the same Python-level redistribution call structure
+    assert plan_q.redistributes == plan_b.redistributes
+    bytes_q = sum(v["bytes"] for v in tq.values())
+    bytes_b = sum(v["bytes"] for v in tb.values())
+    assert bytes_q > 0
+    ratio = bytes_b / bytes_q
+    assert ratio >= an.COMMQ_MIN_BYTE_RATIO, (
+        f"{commq}: wire bytes dropped only {ratio:.2f}x vs {base} "
+        f"({bytes_b} -> {bytes_q}); the acceptance bar is "
+        f">= {an.COMMQ_MIN_BYTE_RATIO}x")
+
+
+@pytest.mark.parametrize("commq,base", an.COMMQ_PAIRS,
+                         ids=[c for c, _ in an.COMMQ_PAIRS])
+def test_commq_collectives_move_bf16(commq, base):
+    """Every executed collective of a commq plan carries a bfloat16
+    payload (gathers ride the wire cast, the CALU row-block psum reduces
+    at bf16) -- no full-precision leak in the quantized schedule."""
+    g = _grid(2, 2)
+    plan, _, _ = an.trace_driver(commq, g)
+    moved = [ev for ev in plan.events if ev.axis_size > 1]
+    assert moved
+    assert all(ev.dtype == "bfloat16" for ev in moved), \
+        sorted({(ev.prim, ev.dtype) for ev in moved})
+
+
+@pytest.mark.parametrize("commq,base", an.COMMQ_PAIRS,
+                         ids=[c for c, _ in an.COMMQ_PAIRS])
+def test_commq_noop_on_1x1(commq, base):
+    """On a 1x1 grid the knob is dead (no collectives execute): the commq
+    plan's totals equal the baseline's exactly."""
+    g = _grid(1, 1)
+    plan_q, _, _ = an.trace_driver(commq, g)
+    plan_b, _, _ = an.trace_driver(base, g)
+    assert plan_q.totals() == plan_b.totals()
+    assert plan_q.redistributes == plan_b.redistributes
+
+
+def test_commq_variants_registered_with_bf16_optin():
+    """The commq specs opt into EL005 (bf16 on the wire is intentional
+    here); their full-precision twins do not."""
+    for commq, base in an.COMMQ_PAIRS:
+        assert an.DRIVERS[commq].allow_bf16 is True
+        assert an.DRIVERS[base].allow_bf16 is False
+    assert an.COMMQ_MIN_BYTE_RATIO == 1.9

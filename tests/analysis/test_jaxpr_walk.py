@@ -118,3 +118,43 @@ def test_loop_variant_collective_not_flagged(g22):
     closed = jax.make_jaxpr(_smap(g22, body))(
         jax.ShapeDtypeStruct((4,), jnp.float32))
     assert find_loop_invariant_collectives(closed) == []
+
+
+# ---------------------------------------------------------------------
+# payload-dtype-aware byte estimates (ISSUE 8 satellite): the estimator
+# reads the ACTUAL collective operand dtype(s), so convert-before-
+# collective patterns (the comm_precision encode path, PR 1's bf16
+# updates) are priced at their true wire bytes
+# ---------------------------------------------------------------------
+
+def test_convert_before_collective_prices_wire_dtype(g22):
+    """Casting to bf16 right before the all_gather halves the estimated
+    bytes: the walker must read the collective operand's aval, never
+    assume the traced program's input dtype."""
+    def body(x):
+        return lax.all_gather(x.astype(jnp.bfloat16), ("mc", "mr"),
+                              axis=0).astype(jnp.float32).sum(0)
+
+    fn = _smap(g22, body)
+    closed = jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((8, 8), jnp.float32))
+    evs = collect_events(closed)
+    assert len(evs) == 1
+    ev = evs[0]
+    assert ev.dtype == "bfloat16"
+    assert ev.bytes_per_call == estimate_bytes("all_gather", 8 * 8 * 2, 4)
+
+
+def test_multi_operand_psum_sums_all_payloads(g22):
+    """A tuple psum is ONE equation with several array operands: the byte
+    estimate sums every payload at its own dtype (the old first-operand
+    shortcut under-reported mixed-dtype reductions)."""
+    def body(x):
+        a, b = lax.psum((x, (2 * x).astype(jnp.bfloat16)), ("mc", "mr"))
+        return a + b.astype(jnp.float32)
+
+    fn = _smap(g22, body)
+    closed = jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((8, 8), jnp.float32))
+    evs = [e for e in collect_events(closed) if e.prim == "psum"]
+    assert len(evs) == 1
+    nbytes = 8 * 8 * 4 + 8 * 8 * 2          # f32 operand + bf16 operand
+    assert evs[0].bytes_per_call == estimate_bytes("psum", nbytes, 4)

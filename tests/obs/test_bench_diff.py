@@ -101,3 +101,26 @@ def test_repo_trajectory_gates_clean(bd):
     repo = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir)
     cur = os.path.join(repo, "BENCH_r05.json")
     assert bd.main(["--check", cur]) == 0
+
+
+def test_obs_wire_bytes_key_accepted_not_gated(bd, tmp_path, capsys):
+    """ISSUE 8: a current doc carrying the new obs.redist_wire_bytes
+    total (and a comm_precision tuner provenance field) passes the gate
+    against baselines that predate the key -- surfaced as an
+    informational line, never a regression (the rename guard stays
+    false-positive-free)."""
+    _write(tmp_path, "BENCH_r01.json", value=10.0, vs_baseline=0.70)
+    doc = {"metric": "cholesky_n32768_tflops_per_chip", "value": 10.0,
+           "unit": "TFLOP/s", "vs_baseline": 0.70, "lu_value": 5.0,
+           "lu_vs_baseline": 0.35,
+           "tuner": {"ran_with": {"nb": 2048, "comm_precision": None},
+                     "lu": {"config": {"comm_precision": "bf16"},
+                            "source": "cost_model"}},
+           "obs": {"schema": "obs_bench/v1", "redist_bytes": 1000,
+                   "redist_wire_bytes": 500}}
+    path = tmp_path / "BENCH_r02.json"
+    path.write_text(json.dumps({"parsed": doc}))
+    assert bd.main(["--check", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "redist_wire_bytes: 500" in out and "2.00x" in out
+    assert "REGRESSION" not in out

@@ -176,23 +176,28 @@ def test_fault_matrix_no_silent_garbage(grid24, target, kind, mode):
 
 
 def test_oneshot_fault_escalation_order_pinned(grid24):
-    """A one-shot NaN on the first panel_spread corrupts rung 'fast''s
-    factor; 'refine' (same factor) cannot fix it; 'fp32' refactors
-    cleanly and certifies -- the ladder order refine -> fp32 pinned."""
+    """One-shot NaNs on the first TWO panel_spreads corrupt the 'quant'
+    and 'fast' factors (one spread per factorization at this geometry);
+    'refine' (sharing fast's factor) cannot fix it; 'fp32' refactors
+    cleanly and certifies -- the ladder order quant -> fast -> refine ->
+    fp32 pinned, including the shares-the-factor semantics of 'refine'."""
     rng = np.random.default_rng(106)
     An, Bn = _problem(rng, 24, "hpd")
     plan = FaultPlan(seed=5, faults=[FaultSpec("panel_spread", "nan",
-                                               call=0)])
+                                               call=0),
+                                     FaultSpec("panel_spread", "nan",
+                                               call=1)])
     with fault_injection(plan):
         X, info = certified_solve("hpd", _dist(grid24, An),
                                   _dist(grid24, Bn), nb=8)
     assert info["certified"] is True
     assert info["rung"] == "fp32"
-    assert [a["rung"] for a in info["attempts"]] == ["fast", "refine",
-                                                     "fp32"]
+    assert [a["rung"] for a in info["attempts"]] == ["quant", "fast",
+                                                     "refine", "fp32"]
     assert _clean_resid(An, Bn, X) <= info["tol"]
     # the corrupted attempts carry their health evidence
     assert info["attempts"][0]["health"]["ok"] is False
+    assert info["attempts"][1]["health"]["ok"] is False
 
 
 def test_persistent_corruption_surfaced_with_phase(grid24):
@@ -209,4 +214,4 @@ def test_persistent_corruption_surfaced_with_phase(grid24):
     assert info["failing_phase"] is not None
     assert info["health"] is not None
     assert [a["rung"] for a in info["attempts"]] \
-        == ["fast", "refine", "fp32", "classic"]
+        == ["quant", "fast", "refine", "fp32", "classic"]

@@ -1,0 +1,112 @@
+"""The comm_precision knob in the tuning subsystem (ISSUE 8): registry
+coverage, candidate enumeration rules, 'auto' resolution, and the
+bytes-vs-decode-flops cost-model term."""
+import jax
+import jax.numpy as jnp
+import pytest
+
+import elemental_tpu as el
+from elemental_tpu import tune
+from elemental_tpu.tune import cost_model
+from elemental_tpu.tune.knobs import (COMM_PRECISIONS, OPS, TuneContext,
+                                      candidate_configs)
+
+
+def _grid(r, c):
+    return el.Grid(jax.devices()[: r * c], height=r)
+
+
+def _ctx(op, dims, grid_shape):
+    return TuneContext(op=op, dims=dims, dtype="float32",
+                       grid_shape=grid_shape, backend="cpu")
+
+
+def test_every_op_registers_the_knob():
+    for op, spec in OPS.items():
+        assert "comm_precision" in spec.knobs, op
+
+
+def test_candidates_dead_on_1x1_full_on_2x2():
+    ctx1 = _ctx("cholesky", (64, 64), (1, 1))
+    assert {c["comm_precision"] for c in candidate_configs(ctx1)} == {None}
+    ctx2 = _ctx("cholesky", (64, 64), (2, 2))
+    assert {c["comm_precision"] for c in candidate_configs(ctx2)} \
+        == set(COMM_PRECISIONS)
+
+
+def test_pinned_value_freezes_the_dimension():
+    ctx = _ctx("lu", (64, 64), (2, 2))
+    cands = candidate_configs(ctx, {"comm_precision": "bf16"})
+    assert {c["comm_precision"] for c in cands} == {"bf16"}
+    # pinning None (the driver default) keeps the space un-tripled
+    base = candidate_configs(ctx, {"comm_precision": None})
+    assert len(cands) == len(base)
+
+
+def test_auto_resolves_none_on_1x1_and_quantized_when_bandwidth_bound():
+    g1 = _grid(1, 1)
+    kn = tune.resolve_knobs("cholesky", gshape=(64, 64), dtype=jnp.float32,
+                            grid=g1, knobs={"nb": 16, "lookahead": True,
+                                            "crossover": 0,
+                                            "comm_precision": "auto"})
+    assert kn["comm_precision"] is None
+    g2 = _grid(2, 2)
+    kn = tune.resolve_knobs("cholesky", gshape=(4096, 4096),
+                            dtype=jnp.float32, grid=g2,
+                            knobs={"nb": 256, "lookahead": True,
+                                   "crossover": 0,
+                                   "comm_precision": "auto"})
+    # a big bandwidth-bound geometry buys the narrower wire
+    assert kn["comm_precision"] in ("bf16", "int8")
+
+
+def test_explicit_none_always_wins():
+    """A user who did not opt in (driver default None) never gets a
+    quantized wire from resolving OTHER knobs."""
+    g2 = _grid(2, 2)
+    kn = tune.resolve_knobs("cholesky", gshape=(2048, 2048),
+                            dtype=jnp.float32, grid=g2,
+                            knobs={"nb": "auto", "lookahead": "auto",
+                                   "crossover": "auto",
+                                   "comm_precision": None})
+    assert kn["comm_precision"] is None
+    assert isinstance(kn["nb"], int)
+
+
+@pytest.mark.parametrize("mode,factor", sorted(cost_model.WIRE_FACTORS.items()))
+def test_cost_model_wire_term(mode, factor):
+    """The quantized candidate's bandwidth term shrinks by the mode's
+    factor and gains a decode term -- scored WITHOUT re-tracing (the
+    closed-form gemm path makes this cheap to pin exactly)."""
+    ctx = _ctx("gemm", (512, 512, 512), (2, 2))
+    base = cost_model.score_config("gemm", {"alg": "C", "nb": 128,
+                                            "comm_precision": None},
+                                   ctx=ctx, dtype=jnp.float32)
+    quant = cost_model.score_config("gemm", {"alg": "C", "nb": 128,
+                                             "comm_precision": mode},
+                                    ctx=ctx, dtype=jnp.float32)
+    # gemm alg C moves only all_gathers -> the whole byte total scales
+    # (both modes price at the bf16 factor: gemm's pairs degrade int8)
+    assert quant.comm_bytes == pytest.approx(0.5 * base.comm_bytes)
+    assert quant.bandwidth_s < base.bandwidth_s
+    assert quant.decode_s > 0 and base.decode_s == 0.0
+    assert quant.rounds == base.rounds
+
+
+def test_traced_driver_wire_term_orthogonal():
+    """For the traced factorizations the wire factor scales bytes without
+    re-tracing: prim counts and rounds are identical across modes."""
+    g2 = _grid(2, 2)
+    ctx = _ctx("cholesky", (64, 64), (2, 2))
+    outs = {}
+    for mode in COMM_PRECISIONS:
+        outs[mode] = cost_model.score_config(
+            "cholesky", {"nb": 16, "lookahead": True, "crossover": 0,
+                         "comm_precision": mode},
+            ctx=ctx, grid=g2, dtype=jnp.float32)
+    assert outs["bf16"].prim_counts == outs[None].prim_counts
+    assert outs["bf16"].rounds == outs[None].rounds
+    assert outs["bf16"].comm_bytes == pytest.approx(
+        cost_model.WIRE_FACTORS["bf16"] * outs[None].comm_bytes)
+    assert outs["int8"].comm_bytes < outs["bf16"].comm_bytes
+    assert outs["int8"].decode_s > outs["bf16"].decode_s

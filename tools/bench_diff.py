@@ -29,7 +29,11 @@ forms may repeat; built-in per-metric defaults live in
     current < (1 - threshold) * max(baselines)
 
 i.e. the gate compares against the BEST recorded value, so a slow decay
-across rounds cannot ratchet the bar down.  Metrics absent from the
+across rounds cannot ratchet the bar down.  Nested documents under the
+``"obs"`` key (the ``obs_bench/v1`` trail, including ISSUE 8's
+``redist_wire_bytes`` total) are accepted and surfaced as informational
+lines, never gated -- byte estimates are schedule properties, not
+chip-weather measurements.  Metrics absent from the
 current run or from every baseline are skipped with a note (older rounds
 predate some metrics) -- which is also how METRIC RENAMES stay
 false-positive-free: the bench names its headline values
@@ -162,6 +166,15 @@ def main(argv=None) -> int:
     rows = compare(current, baselines, gated, thresholds)
     print(f"# current: {current_path}   baselines: "
           f"{', '.join(os.path.basename(p) for p in baseline_paths)}")
+    obs = current.get("obs")
+    if isinstance(obs, dict) \
+            and isinstance(obs.get("redist_wire_bytes"), (int, float)):
+        logical = obs.get("redist_bytes")
+        note = ""
+        if isinstance(logical, (int, float)) and logical:
+            note = f"  (logical {logical}, " \
+                   f"{logical / max(obs['redist_wire_bytes'], 1):.2f}x)"
+        print(f"# redist_wire_bytes: {obs['redist_wire_bytes']}{note}")
     print(f"{'metric':20s} {'current':>10s} {'best':>10s} {'delta':>8s} "
           f"{'thresh':>7s}  {'best from'}")
     failed = 0

@@ -15,7 +15,11 @@
 #
 #   tools/check.sh            # everything
 #   tools/check.sh style      # ruff (or the stdlib fallback) only
-#   tools/check.sh comm       # comm-plan lint + golden diff only
+#   tools/check.sh comm       # comm-plan lint + golden diff + the
+#                             #   quantized-collective gate (codec tests,
+#                             #   *_commq golden byte-ratio pins, and the
+#                             #   certified-solve smoke whose first rung
+#                             #   runs int8 wire precision)
 #   tools/check.sh tune       # cost-model self-check + tests/tune only
 #   tools/check.sh obs        # perf.trace smoke + bench_diff gate + tests/obs
 #   tools/check.sh lapack     # calu/tsqr gate: lu/qr comm lint + golden diff,
@@ -44,6 +48,15 @@ if [ "$what" = "all" ] || [ "$what" = "comm" ]; then
     python -m perf.comm_audit lint --all || rc=1
     echo "== golden comm-plan diff =="
     python -m perf.comm_audit diff --all || rc=1
+    echo "== quantized-collective golden diff (*_commq variants) =="
+    python -m perf.comm_audit diff lu_calu_commq || rc=1
+    python -m perf.comm_audit diff cholesky_lookahead_commq || rc=1
+    echo "== quantization codec + comm_precision tier-1 tests =="
+    python -m pytest tests/core/test_comm_precision.py \
+        tests/analysis/test_comm_precision_plan.py \
+        -q -m 'not slow' -p no:cacheprovider || rc=1
+    echo "== certified-solve smoke (quantized first rung) =="
+    JAX_PLATFORMS=cpu python -m perf.certify smoke || rc=1
 fi
 
 if [ "$what" = "all" ] || [ "$what" = "tune" ]; then
