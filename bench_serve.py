@@ -1,0 +1,138 @@
+"""Serving benchmark: latency percentiles + throughput of the solver
+service (ISSUE 9).
+
+Prints ONE JSON line (``bench_serve/v1``)::
+
+    {"schema": "bench_serve/v1", "serve_p50_ms": ..., "serve_p99_ms": ...,
+     "serve_solves_per_sec": ..., "requests": N, "ok": N, "batches": ...,
+     "exec_compiles": ..., "exec_hits": ..., "grid": [r, c],
+     "backend": "cpu", "n": ..., "warmup_requests": ...}
+
+into the BENCH flow: ``tools/bench_diff.py`` gates ``serve_p99_ms``
+(lower-is-better) and ``serve_solves_per_sec`` alongside the TFLOP/s
+headlines, so a serving-latency regression fails the gate exactly like a
+factorization-throughput regression.
+
+Methodology: a WARMUP pass first touches every (bucket, batch-slot)
+geometry so AOT compiles happen outside the measured window (that is the
+executor cache's contract: no serving request pays compile) -- then the
+measured pass submits ``--requests`` mixed lu/hpd problems and drains.
+Latency is per-request submit->finalize wall clock as recorded in each
+``serve_result/v1``; throughput is requests completed / drain seconds.
+
+Flags: ``--requests N`` (default 64), ``--n N`` (system size, default
+96), ``--grid RxC``, ``--seed S``, ``--smoke`` (tiny sizes + schema
+sanity only -- the check.sh path).  CPU-safe via the same virtual
+8-device mesh as ``perf.trace``.
+"""
+import json
+import sys
+import time
+
+BENCH_SERVE_SCHEMA = "bench_serve/v1"
+
+
+def _percentile(sorted_vals, q: float):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[idx]
+
+
+def run_bench(requests: int, n: int, grid_spec, seed: int) -> dict:
+    import numpy as np
+    from perf.trace import _grid
+    from perf.serve import _workload
+    from elemental_tpu.obs import metrics as _metrics
+    from elemental_tpu.serve import SolverService
+
+    grid = _grid(grid_spec)
+    svc = SolverService(grid)
+    rng = np.random.default_rng(seed)
+
+    # warmup: a full-size pass, so every (bucket, batch-slot) geometry of
+    # the measured workload -- including the max_batch slot count the
+    # drain's batching produces -- compiles here, outside the window
+    warm = _workload(rng, requests, n)
+    for op, A, B in warm:
+        svc.submit(op, A, B)
+    svc.drain()
+
+    with _metrics.scoped() as reg:
+        work = _workload(rng, requests, n)
+        t0 = time.perf_counter()
+        for op, A, B in work:
+            svc.submit(op, A, B)
+        docs = svc.drain()
+        wall = time.perf_counter() - t0
+        events: dict = {}
+        for (name, labels), v in \
+                reg.counters("serve_exec_cache_events").items():
+            ev = dict(labels).get("event")
+            events[ev] = events.get(ev, 0) + v
+        batches = sum(v for (name, labels), v
+                      in reg.counters("serve_batches").items())
+
+    lats = sorted(d["latency_s"] for d in docs.values())
+    ok = sum(d["status"] == "ok" for d in docs.values())
+    import jax
+    return {
+        "schema": BENCH_SERVE_SCHEMA,
+        "serve_p50_ms": 1e3 * _percentile(lats, 0.50),
+        "serve_p99_ms": 1e3 * _percentile(lats, 0.99),
+        "serve_solves_per_sec": len(docs) / wall if wall > 0 else None,
+        "requests": len(docs), "ok": ok, "batches": int(batches),
+        "exec_compiles": int(events.get("compile", 0)),
+        "exec_hits": int(events.get("hit", 0)),
+        "grid": [grid.height, grid.width],
+        "backend": jax.default_backend(), "n": n,
+        "warmup_requests": len(warm),
+    }
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    requests, n = 64, 96
+    grid_spec = None
+    seed = 0
+    smoke = False
+    it = iter(argv)
+    for arg in it:
+        if arg == "--requests":
+            requests = int(next(it))
+        elif arg == "--n":
+            n = int(next(it))
+        elif arg == "--grid":
+            grid_spec = next(it)
+        elif arg == "--seed":
+            seed = int(next(it))
+        elif arg == "--smoke":
+            smoke = True
+        elif arg.startswith("--"):
+            raise SystemExit(f"unknown flag {arg!r}")
+        else:
+            raise SystemExit(f"unexpected argument {arg!r}")
+    if smoke:
+        requests, n = min(requests, 12), min(n, 24)
+    from perf.trace import _bootstrap
+    _bootstrap()
+    doc = run_bench(requests, n, grid_spec, seed)
+    print(json.dumps(doc))
+    if smoke:
+        # schema sanity: the gateable keys must be present and numeric
+        bad = [k for k in ("serve_p50_ms", "serve_p99_ms",
+                           "serve_solves_per_sec")
+               if not isinstance(doc.get(k), (int, float))]
+        if bad or doc["ok"] != doc["requests"]:
+            print(f"# bench_serve smoke FAILED: bad={bad} "
+                  f"ok={doc['ok']}/{doc['requests']}", file=sys.stderr)
+            return 1
+        print("# bench_serve smoke: ok", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

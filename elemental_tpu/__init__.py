@@ -26,9 +26,10 @@ from .redist.engine import redistribute, transpose_dist, panel_spread
 __version__ = "0.2.0"
 
 from . import (blas, lapack, matrices, optimization, control, lattice, tune,
-               obs, resilience)
+               obs, resilience, serve)
 from .resilience import (certified_solve, HealthMonitor, last_health_report,
                          FaultPlan, FaultSpec, fault_injection)
+from .serve import SolverService, Deadline
 from .blas import (gemm, herk, syrk, trrk, trsm, trr2k, her2k, syr2k,
                    hemm, symm, trmm, two_sided_trsm, two_sided_trmm,
                    multishift_trsm, quasi_trsm)

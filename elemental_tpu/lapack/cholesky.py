@@ -60,7 +60,8 @@ from jax import lax
 from ..core.dist import MC, MR, VC, STAR
 from ..core.distmatrix import DistMatrix
 from ..core.view import view, update_view
-from ..redist.engine import redistribute, transpose_dist, panel_spread
+from ..redist.engine import (apply_fault, redistribute, transpose_dist,
+                             panel_spread)
 from ..redist.quantize import check_comm_precision
 from ..blas.level1 import make_trapezoidal, _global_indices
 from ..blas.level3 import _blocksize, _check_mcmr, _mask_triangle, trsm
@@ -76,6 +77,14 @@ _CROSSOVER = 4096
 
 
 def _potrf_inv(D, precision, bs: int = 512):
+    """:func:`_potrf_inv_impl` routed through the engine's ``'compute'``
+    fault seam (identity unless a FaultPlan is installed -- ISSUE 9):
+    the diagonal-block factor/inverse pair IS cholesky's local panel
+    math, so corrupting it here models a soft error in local compute."""
+    return apply_fault("compute", _potrf_inv_impl(D, precision, bs))
+
+
+def _potrf_inv_impl(D, precision, bs: int = 512):
     """Blocked lower Cholesky of a (w, w) Hermitian block (lower triangle
     valid) returning ``(L, L^{-1})`` with all O(w^3) work as MXU matmuls.
 

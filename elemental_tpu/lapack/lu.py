@@ -81,7 +81,8 @@ from ..core.compat import shard_map
 from ..core.dist import MC, MR, STAR, VC, VR
 from ..core.distmatrix import DistMatrix
 from ..core.view import view, update_view
-from ..redist.engine import move_rows, permute_rows_storage, redistribute
+from ..redist.engine import (apply_fault, move_rows, permute_rows_storage,
+                             redistribute)
 from ..redist.quantize import check_comm_precision, quantizable
 from ..blas.level3 import _blocksize, _check_mcmr, local_rank_update, trsm
 
@@ -688,13 +689,18 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
 
     def factor_panel(Ploc, w: int, step: int):
         """One panel under the selected strategy; ticks the tournament
-        phase (obs) between pivot selection and the unpivoted refactor."""
+        phase (obs) between pivot selection and the unpivoted refactor.
+        The packed result routes through the engine's 'compute' fault
+        seam (identity unless a FaultPlan is installed -- ISSUE 9)."""
         if not calu or Ploc.shape[0] <= w:
-            return _panel_lu(Ploc, w, precision)
-        pperm = _tournament_pivots(Ploc, w, r)
-        tm.tick("tournament", step, pperm)
-        Pp = jnp.take(Ploc, pperm, axis=0)
-        return _nopiv_panel(Pp, w, precision), pperm
+            Pf, pperm = _panel_lu(Ploc, w, precision)
+        else:
+            pperm = _tournament_pivots(Ploc, w, r)
+            tm.tick("tournament", step, pperm)
+            Pp = jnp.take(Ploc, pperm, axis=0)
+            Pf = _nopiv_panel(Pp, w, precision)
+        Pf, = apply_fault("compute", (Pf,))
+        return Pf, pperm
 
     ib = _blocksize(nb, math.lcm(r, c), min(m, n))
     kend = min(m, n)

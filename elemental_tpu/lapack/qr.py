@@ -30,7 +30,7 @@ from ..core.dist import MC, MR, VC, STAR
 from ..core.distmatrix import DistMatrix
 from ..core.view import view, update_view
 from ..core.compat import shard_map
-from ..redist.engine import redistribute
+from ..redist.engine import apply_fault, redistribute
 from ..blas.level3 import _blocksize, _check_mcmr, trsm
 from .lu import (_update_cols_lt, _update_cols_ge, _hi, _phase_hook,
                  _nopiv_panel)
@@ -195,7 +195,7 @@ def _panel_qr_tsqr(P, r: int, precision=None):
 
 def qr(A: DistMatrix, nb: int | str | None = None, precision=None,
        panel: str = "classic", comm_precision: str | None = None,
-       timer=None):
+       timer=None, health=None):
     """Blocked Householder QR; returns (packed, tau) in geqrf format.
 
     ``nb='auto'`` asks the tuning subsystem for the panel width.  The
@@ -222,7 +222,18 @@ def qr(A: DistMatrix, nb: int | str | None = None, precision=None,
     sweep's only bulk collective): narrow encode -> gather -> decode, so
     the gathers move 2-4x fewer bytes at identical round counts.
     Opt-in; ``None`` (default) is bit-identical.  See the README's
-    "Quantized collectives" section for the accuracy trade."""
+    "Quantized collectives" section for the accuracy trade.
+
+    ``health`` opts into the resilience subsystem's numerical-health
+    guards, with the same contract as ``lu``/``cholesky`` (ISSUE 7 gap
+    closed in ISSUE 9): pass a ``HealthMonitor`` (read
+    ``monitor.report()`` afterwards) or ``True`` (report retrievable via
+    ``resilience.last_health_report('qr')``).  Every panel/update tick is
+    NaN/Inf-scanned and growth-tracked, and the packed panel's diagonal
+    -- which carries R's diagonal (the larfg betas) -- is checked for
+    near-zero entries, the QR image of rank deficiency.  ``health=None``
+    (default) attaches nothing: the zero-overhead NULL_HOOK path, pinned
+    by redist-count equality and the unchanged qr/qr_tsqr comm goldens."""
     _check_mcmr(A)
     m, n = A.gshape
     g = A.grid
@@ -241,6 +252,10 @@ def qr(A: DistMatrix, nb: int | str | None = None, precision=None,
         raise ValueError(f"qr: unknown panel strategy {panel!r}; "
                          "expected 'classic', 'tsqr', or 'auto'")
     tm = _phase_hook("qr", timer)
+    hm = None
+    if health:
+        from ..resilience.health import attach_health
+        tm, hm = attach_health("qr", health, tm, scale_from=A)
     tm.start()
     r, c = g.height, g.width
     ib = _blocksize(nb, math.lcm(r, c), min(m, n))
@@ -257,6 +272,7 @@ def qr(A: DistMatrix, nb: int | str | None = None, precision=None,
             Pf, tau = _panel_qr_tsqr(panel_ss.local[:, :nbw], r, precision)
         else:
             Pf, tau = _panel_qr(panel_ss.local[:, :nbw])
+        Pf, = apply_fault("compute", (Pf,))
         taus.append(tau)
         tm.tick("panel", k, Pf, tau)
         if e_up > e:
@@ -279,6 +295,8 @@ def qr(A: DistMatrix, nb: int | str | None = None, precision=None,
                                 (s, m), (s, n), e)
             tm.tick("update", k, A)
     _record_qr_nb(A, ib)
+    if hm is not None:
+        hm.report()
     return A, jnp.concatenate(taus) if taus else jnp.zeros((0,), A.dtype)
 
 

@@ -181,6 +181,21 @@ def fault_injection(plan):
         _FAULT_INJECTOR = prev
 
 
+def apply_fault(target: str, outputs: tuple) -> tuple:
+    """Route eager kernel outputs through the installed fault injector;
+    identity (and zero-overhead) when none is installed.
+
+    The engine corrupts its own ``redistribute``/``panel_spread`` payloads
+    internally; this is the seam OTHER layers use for the ``'compute'``
+    fault target (ISSUE 9) -- the lu/cholesky/qr panel kernels and the
+    serve executor's batched solve route their local outputs through it,
+    so chaos tests cover soft errors in local math with the same seeded
+    bit-identical replay guarantee as the collective targets."""
+    if _FAULT_INJECTOR is None:
+        return tuple(outputs)
+    return tuple(_FAULT_INJECTOR.apply(target, tuple(outputs)))
+
+
 def _trace_record(kind, src, dst, gshape, dtype, objs_in, objs_out,
                   grid_shape=(), wire_dtype=None):
     if _REDIST_TRACE is None and not _REDIST_OBSERVERS:

@@ -9,11 +9,15 @@ makes numerical corruption DETECTED, REPORTED, and RECOVERED:
                    seam (``lu(..., health=...)``) -> ``health_report/v1``
   :mod:`.certify`  ``certified_solve``: true-residual certificate +
                    iterative refinement + the deterministic escalation
-                   ladder (fast -> refine -> fp32 -> classic)
+                   ladder (quant -> fast -> refine -> fp32 -> classic),
+                   deadline-boundable via ``deadline=`` (ISSUE 9: an
+                   exhausted budget returns best-so-far + ``timed_out``)
   :mod:`.faults`   seeded ``FaultPlan`` corruption of engine payloads
                    (install via :func:`fault_injection`, the
-                   ``redist.engine`` seam) -- the test harness proving
-                   every corruption class is repaired or surfaced
+                   ``redist.engine`` seam) and -- via the ``compute``
+                   target (ISSUE 9) -- of local panel/batch kernel
+                   outputs -- the test harness proving every corruption
+                   class is repaired or surfaced
 
 CLI: ``python -m perf.certify {run,smoke}``; gate: ``tools/check.sh
 resilience``.

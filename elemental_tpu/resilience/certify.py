@@ -58,8 +58,14 @@ certificate carries the health report naming the failing phase.
                                      #   perturbs exact zeros off the
                                      #   diagonal, so quant rungs cannot
                                      #   attest singularity either way)
+     "timed_out": false,             # a ``deadline=`` expired before the
+                                     #   ladder finished (ISSUE 9): the
+                                     #   certificate is best-so-far, not
+                                     #   the full ladder's verdict
      "failing_phase": null,          # first health-flagged phase /
-                                     #   "diag" (singular) / "residual"
+                                     #   "diag" (singular) / "deadline"
+                                     #   (timed out, no other evidence) /
+                                     #   "residual"
      "health": {...}}                # last attempt's health_report/v1
 
 The residual certified is ``||B - A X||_F / (||A||_F ||X||_F + ||B||_F)``
@@ -186,18 +192,29 @@ def _factor_matrix(op: str, factor):
 # ---------------------------------------------------------------------
 
 def certified_solve(op: str, A, B, *, tol: float | None = None,
-                    nb: int | None = None, ladder=None, health: bool = True):
+                    nb: int | None = None, ladder=None, health: bool = True,
+                    deadline=None):
     """Solve ``A X = B`` with a residual certificate and escalation.
 
     ``op``: ``'lu'`` (general square A) or ``'hpd'`` (Hermitian positive
     definite A; ``'cholesky'`` is accepted as an alias).  Returns
     ``(X, info)`` with ``info`` a ``solve_certificate/v1`` document (see
-    module docstring); ``X`` is the best solution produced (``None`` only
-    when every attempted factorization was singular).  ``tol`` defaults
+    module docstring); ``X`` is the best solution produced (``None`` when
+    no attempt produced one: every attempted factorization was singular,
+    or the deadline expired before the first rung).  ``tol`` defaults
     to the documented ``64 * n * eps(A.dtype)``; ``ladder`` overrides the
     rung sequence (a tuple of :class:`Rung`); ``health=False`` skips the
     per-attempt health monitors (the certificate alone still guards the
     result).  EAGER-mode: the escalation control flow is host-side.
+
+    ``deadline`` (ISSUE 9) bounds wall-clock: any object with a
+    ``remaining() -> seconds`` method (canonically
+    :class:`elemental_tpu.serve.Deadline`).  Every rung attempt -- and
+    every refinement iteration -- checks the remaining budget BEFORE
+    launching; an exhausted budget stops the ladder and returns the
+    best-so-far solution with ``timed_out=True`` in the certificate
+    instead of silently running the remaining rungs, so the worst-case
+    overrun is one rung, never the whole ladder.
     """
     if op == "cholesky":
         op = "hpd"
@@ -218,7 +235,12 @@ def certified_solve(op: str, A, B, *, tol: float | None = None,
     diag = None
     monitor = None
     X = None
+    timed_out = False
+    best = None                           # (residual, X, refine_iters)
     for rung in rungs:
+        if deadline is not None and deadline.remaining() <= 0.0:
+            timed_out = True              # check BEFORE launch: the only
+            break                         # overrun is the rung in flight
         att = {"rung": rung.name, "residual": None, "refine_iters": 0,
                "singular": False, "diag_index": None, "health": None}
         if rung.refactor or factor is None:
@@ -236,6 +258,9 @@ def certified_solve(op: str, A, B, *, tol: float | None = None,
         res = _residual(An, Bn, _host(X), normA, normB)
         it = 0
         while res > tol and it < rung.refine and np.isfinite(res):
+            if deadline is not None and deadline.remaining() <= 0.0:
+                timed_out = True
+                break
             with np.errstate(over="ignore", invalid="ignore"):
                 Rn = Bn - An @ _host(X)
             if not np.isfinite(Rn).all():
@@ -254,15 +279,23 @@ def certified_solve(op: str, A, B, *, tol: float | None = None,
         att["residual"] = res if np.isfinite(res) else None
         att["refine_iters"] = it
         attempts.append(att)
+        if np.isfinite(res) and (best is None or res < best[0]):
+            best = (res, X, it)
         if np.isfinite(res) and res <= tol:
             return X, _certificate(op, True, rung.name, res, tol, it,
                                    rungs, attempts)
-    last = attempts[-1] if attempts else None
-    res = last["residual"] if last else None
-    cert = _certificate(op, False, None,
-                        res if res is not None else float("nan"),
-                        tol, last["refine_iters"] if last else 0,
-                        rungs, attempts)
+        if timed_out:
+            break
+    # ladder exhausted or deadline expired: best-so-far, never certified
+    if best is not None:
+        res_out, X, it_out = best
+    else:
+        last = attempts[-1] if attempts else None
+        res_out = last["residual"] if last and last["residual"] is not None \
+            else float("nan")
+        it_out = last["refine_iters"] if last else 0
+    cert = _certificate(op, False, None, res_out, tol, it_out,
+                        rungs, attempts, timed_out=timed_out)
     if cert["singular"]:
         # the only solves produced (if any) came from wire-quantized
         # factors of an attested-singular system: suppress the garbage
@@ -270,7 +303,7 @@ def certified_solve(op: str, A, B, *, tol: float | None = None,
     return X, cert
 
 
-def _failing_phase(attempts) -> str | None:
+def _failing_phase(attempts, timed_out=False) -> str | None:
     for att in attempts:
         rep = att.get("health")
         if rep and rep.get("flags"):
@@ -278,11 +311,13 @@ def _failing_phase(attempts) -> str | None:
     for att in attempts:
         if att.get("singular"):
             return "diag"
+    if timed_out:
+        return "deadline"                 # budget, not numerics, stopped us
     return "residual"
 
 
 def _certificate(op, certified, rung, residual, tol, iters, rungs,
-                 attempts) -> dict:
+                 attempts, timed_out=False) -> dict:
     last_health = None
     for att in reversed(attempts):
         if att.get("health") is not None:
@@ -303,5 +338,7 @@ def _certificate(op, certified, rung, residual, tol, iters, rungs,
             "attempts": attempts,
             "singular": bool(attested) and all(a["singular"]
                                                for a in attested),
-            "failing_phase": None if certified else _failing_phase(attempts),
+            "timed_out": bool(timed_out),
+            "failing_phase": None if certified
+            else _failing_phase(attempts, timed_out),
             "health": last_health}

@@ -25,12 +25,15 @@ Corruption classes (``FaultSpec.kind``):
   * ``'nan'``      -- splat NaN: the poisoned-collective model.
 
 Targets (``FaultSpec.target``): ``'redistribute'`` and ``'panel_spread'``
--- the engine's two public data-motion entries.  Call indices count
-Python-level entries per target (the same counting semantics as
-``engine.REDIST_COUNTS``), starting at 0 when the plan is installed;
-``every=True`` corrupts every call from ``call`` onward (the persistent-
-corruption mode certified solves must SURFACE, vs the one-shot mode they
-must REPAIR).
+-- the engine's two public data-motion entries -- plus ``'compute'``
+(ISSUE 9): LOCAL math outputs routed through ``engine.apply_fault`` --
+the lu/cholesky/qr panel kernels and the serve executor's batched solve
+-- so chaos tests cover soft errors in local compute, not just corrupted
+collectives.  Call indices count Python-level entries per target (the
+same counting semantics as ``engine.REDIST_COUNTS``), starting at 0 when
+the plan is installed; ``every=True`` corrupts every call from ``call``
+onward (the persistent-corruption mode certified solves must SURFACE, vs
+the one-shot mode they must REPAIR).
 
 Like the tracer and the health monitor this is an EAGER-mode tool: a
 payload that is still a jax tracer (an enclosing jit) is counted but
@@ -43,7 +46,9 @@ import dataclasses
 import numpy as np
 
 FAULT_KINDS = ("bitflip", "scale", "nan")
-FAULT_TARGETS = ("redistribute", "panel_spread")
+#: 'compute' was APPENDED in ISSUE 9 -- the enumerate-derived seed words
+#: below keep the original targets' corruption streams bit-identical
+FAULT_TARGETS = ("redistribute", "panel_spread", "compute")
 
 #: stable per-target / per-kind seed words (never reorder: part of the
 #: determinism contract -- a plan's corruption stream is pinned by tests)

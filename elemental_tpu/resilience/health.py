@@ -65,8 +65,10 @@ GROWTH_LIMIT = 1e8
 
 #: phases whose FIRST inexact leaf carries a meaningful diagonal, per
 #: driver: LU packs the pivots on the panel diagonal, Cholesky factors
-#: L11 in the diag phase.  Other drivers get scan + growth only.
-DIAG_PHASES = {"lu": ("panel",), "cholesky": ("diag",)}
+#: L11 in the diag phase, and QR's packed panel carries R's diagonal
+#: (the larfg betas -- near-zero == rank-deficient, the ``small_pivot``
+#: flag; ISSUE 9 parity).  Other drivers get scan + growth only.
+DIAG_PHASES = {"lu": ("panel",), "cholesky": ("diag",), "qr": ("panel",)}
 
 
 def _is_tracer(x) -> bool:

@@ -1,0 +1,49 @@
+"""Solver service: deadline-bounded, batched, fault-isolated serving.
+
+The ISSUE-9 front-end that turns eight PRs of single-solve machinery
+into a system that survives production traffic -- the ROADMAP's
+"millions of users" workload of many small-to-medium solves (arXiv
+2112.09017):
+
+  :mod:`.admission`  shape-bucketing into the tuner's pow2 buckets,
+                     per-request :class:`Deadline` objects threaded
+                     through dispatch, and load shedding that
+                     rejects-fast with ``serve_reject/v1``
+  :mod:`.executor`   padded ``vmap``'d Cholesky/LU batch solves with a
+                     persistent AOT-compiled executable cache (no
+                     request pays compile)
+  :mod:`.policy`     deadline-aware retry with seeded backoff+jitter,
+                     the per-bucket circuit breaker (trip / half-open
+                     probe / close), and the load-aware degradation
+                     ladder (quant-first under pressure)
+  :mod:`.service`    :class:`SolverService` -- submit/drain, trusted
+                     per-request certification, bisect fault isolation,
+                     escalation through ``certified_solve(deadline=)``
+  :mod:`.chaos`      the acceptance-matrix harness over the ISSUE-7
+                     ``FaultPlan`` machinery
+
+CLI: ``python -m perf.serve {run,smoke,chaos}``; bench:
+``python bench_serve.py`` (p50/p99 + solves/sec, gated by
+``tools/bench_diff.py``); gate: ``tools/check.sh serve``.
+"""
+from .admission import (REJECT_SCHEMA, AdmissionController, Bucket,
+                        Deadline, SolveRequest, make_bucket, reject_doc)
+from .executor import (EXEC_SCHEMA, ExecutableCache, Executor, batch_slots,
+                       pad_problem, residual)
+from .policy import (CLOSED, HALF_OPEN, OPEN, CircuitBreaker, RetryPolicy,
+                     select_ladder)
+from .service import RESULT_SCHEMA, SolverService
+from .chaos import (CHAOS_SCHEMA, build_workload, chaos_matrix,
+                    replay_identical, run_cell)
+
+__all__ = [
+    "REJECT_SCHEMA", "AdmissionController", "Bucket", "Deadline",
+    "SolveRequest", "make_bucket", "reject_doc",
+    "EXEC_SCHEMA", "ExecutableCache", "Executor", "batch_slots",
+    "pad_problem", "residual",
+    "CLOSED", "HALF_OPEN", "OPEN", "CircuitBreaker", "RetryPolicy",
+    "select_ladder",
+    "RESULT_SCHEMA", "SolverService",
+    "CHAOS_SCHEMA", "build_workload", "chaos_matrix", "replay_identical",
+    "run_cell",
+]

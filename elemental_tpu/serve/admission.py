@@ -1,0 +1,237 @@
+"""Admission control: shape bucketing, deadlines, and load shedding.
+
+The front door of the solver service (ISSUE 9).  Production traffic at
+the scale the source paper targets (arXiv 2112.09017) is overwhelmingly
+many small-to-medium solves; this module turns an arbitrary stream of
+``A x = b`` requests into a SMALL set of canonical geometries the
+executor can batch and AOT-compile once:
+
+  * **shape bucketing** -- request dims round up to the tuner's
+    power-of-two buckets (:func:`~elemental_tpu.tune.cache.shape_bucket`,
+    the SAME bucketing the tuning cache keys on, so serve buckets and
+    tuned knob entries line up 1:1);
+  * **deadlines** -- every request carries a :class:`Deadline` (budget /
+    elapsed / remaining), the object the whole dispatch chain threads:
+    the batcher drops expired requests before launch, the executor
+    checks it before dispatch, and ``certified_solve(deadline=)`` stops
+    the escalation ladder on it (the ISSUE-9 certify satellite);
+  * **load shedding** -- when the estimated queue wait for a request's
+    bucket (queued batches ahead x the bucket's cost estimate) exceeds
+    its remaining budget, the request is rejected FAST with a structured
+    ``serve_reject/v1`` document instead of being queued to die: the
+    client learns in microseconds, not after the deadline.
+
+Cost estimates are per-bucket EWMAs of measured batch seconds (the
+executor reports every batch it runs), seeded cold by a flops/throughput
+model -- so shedding is conservative on a cold service and converges to
+the observed rate.
+
+All clocks are injectable (``clock=`` on :class:`Deadline` and
+:class:`AdmissionController`), which is what makes the chaos/breaker
+tests deterministic under replay.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+
+import numpy as np
+
+from ..tune.cache import shape_bucket
+
+REJECT_SCHEMA = "serve_reject/v1"
+
+#: reject reasons (pinned by tests/serve)
+REJECT_REASONS = ("queue_pressure", "deadline_expired", "breaker_open",
+                  "bad_request")
+
+#: cold-start throughput assumption for the flops-based cost seed,
+#: flop/s.  Deliberately modest (CPU-class): a cold service sheds
+#: conservatively and the EWMA takes over after the first batch.
+COLD_FLOPS_PER_S = 2.0e9
+
+#: EWMA smoothing for measured batch seconds
+EWMA_ALPHA = 0.4
+
+
+class Deadline:
+    """A wall-clock budget: ``budget`` seconds from construction.
+
+    The request-scoped object the service propagates through dispatch
+    (admission -> batcher -> executor -> escalation); duck-typed by
+    ``certified_solve(deadline=)`` which only needs :meth:`remaining`.
+    ``clock`` is injectable for deterministic tests (default
+    ``time.monotonic``)."""
+
+    __slots__ = ("budget", "clock", "start")
+
+    def __init__(self, budget: float, clock=time.monotonic):
+        self.budget = float(budget)
+        self.clock = clock
+        self.start = clock()
+
+    def elapsed(self) -> float:
+        return self.clock() - self.start
+
+    def remaining(self) -> float:
+        return self.budget - self.elapsed()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def to_doc(self) -> dict:
+        return {"budget_s": self.budget, "elapsed_s": self.elapsed(),
+                "remaining_s": self.remaining()}
+
+    def __repr__(self):
+        return (f"Deadline(budget={self.budget:.3g}s, "
+                f"remaining={self.remaining():.3g}s)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Bucket:
+    """One canonical serve geometry: (op, padded dims, dtype)."""
+    op: str                      # "lu" | "hpd"
+    n: int                       # pow2-bucketed system size
+    nrhs: int                    # pow2-bucketed right-hand-side count
+    dtype: str
+
+    def key(self) -> str:
+        """Cache-key string, same style as ``tuning_cache/v1`` filenames."""
+        return f"{self.op}__b{self.n}x{self.nrhs}__{self.dtype}"
+
+    def solve_flops(self) -> float:
+        """Factor + solve flops of ONE padded problem (the cost seed)."""
+        n, k = float(self.n), float(self.nrhs)
+        factor = (n ** 3) / 3.0 if self.op == "hpd" else 2.0 * (n ** 3) / 3.0
+        return factor + 2.0 * n * n * k
+
+
+def make_bucket(op: str, n: int, nrhs: int, dtype) -> Bucket:
+    """Bucket a concrete request geometry (pow2 per dim, tuner-aligned)."""
+    bn, brhs = shape_bucket((int(n), max(int(nrhs), 1)))
+    return Bucket(op=op, n=int(bn), nrhs=int(brhs), dtype=np.dtype(dtype).name)
+
+
+@dataclasses.dataclass
+class SolveRequest:
+    """One admitted request (host-side problem data + its deadline)."""
+    id: int
+    op: str                      # "lu" | "hpd"
+    A: np.ndarray                # (n, n) host array
+    B: np.ndarray                # (n, nrhs) host array
+    bucket: Bucket
+    deadline: Deadline | None
+    submitted: float             # admission clock timestamp
+
+    @property
+    def n(self) -> int:
+        return int(self.A.shape[0])
+
+    @property
+    def nrhs(self) -> int:
+        return int(self.B.shape[1])
+
+
+def reject_doc(reason: str, *, bucket: Bucket | None = None,
+               queue_depth: int = 0, estimate_s: float | None = None,
+               deadline: Deadline | None = None, detail: str = "") -> dict:
+    """A structured fast-reject (``serve_reject/v1``)."""
+    if reason not in REJECT_REASONS:
+        raise ValueError(f"unknown reject reason {reason!r}; "
+                         f"expected one of {REJECT_REASONS}")
+    return {"schema": REJECT_SCHEMA, "reason": reason,
+            "bucket": bucket.key() if bucket is not None else None,
+            "queue_depth": int(queue_depth),
+            "estimate_s": None if estimate_s is None else float(estimate_s),
+            "deadline": deadline.to_doc() if deadline is not None else None,
+            "detail": detail}
+
+
+class AdmissionController:
+    """Buckets requests, estimates queue cost, sheds load.
+
+    ``admit(op, A, B, deadline, queue_depth)`` validates the request,
+    assigns its bucket, and EITHER returns a :class:`SolveRequest` or a
+    ``serve_reject/v1`` dict when the estimated wait cannot fit the
+    deadline (``shed=False`` disables shedding -- bench mode).  The
+    caller owns the queue; ``queue_depth`` is the number of requests
+    already waiting in the same bucket."""
+
+    def __init__(self, *, shed: bool = True, max_batch: int = 8,
+                 flops_per_s: float = COLD_FLOPS_PER_S,
+                 clock=time.monotonic):
+        self.shed = bool(shed)
+        self.max_batch = max(int(max_batch), 1)
+        self.flops_per_s = float(flops_per_s)
+        self.clock = clock
+        self._ids = itertools.count()
+        self._ewma: dict = {}            # bucket.key() -> seconds per batch
+
+    # ---- cost estimation --------------------------------------------
+    def estimate_batch_s(self, bucket: Bucket) -> float:
+        """Estimated seconds for ONE max_batch batch of this bucket:
+        measured EWMA when warm, flops/throughput when cold."""
+        est = self._ewma.get(bucket.key())
+        if est is not None:
+            return est
+        return bucket.solve_flops() * self.max_batch / self.flops_per_s
+
+    def observe_batch(self, bucket: Bucket, seconds: float) -> None:
+        """Executor feedback: one batch of ``bucket`` took ``seconds``."""
+        key = bucket.key()
+        prev = self._ewma.get(key)
+        s = float(seconds)
+        self._ewma[key] = s if prev is None \
+            else EWMA_ALPHA * s + (1.0 - EWMA_ALPHA) * prev
+
+    def estimated_wait_s(self, bucket: Bucket, queue_depth: int) -> float:
+        """Queue wait estimate: batches ahead x per-batch estimate (the
+        request itself rides the LAST of those batches)."""
+        batches = -(-max(int(queue_depth) + 1, 1) // self.max_batch)
+        return batches * self.estimate_batch_s(bucket)
+
+    # ---- admission ---------------------------------------------------
+    def admit(self, op: str, A, B, deadline: Deadline | None = None,
+              queue_depth=0):
+        """One admission decision: :class:`SolveRequest` or reject dict.
+
+        ``queue_depth`` is the number of same-bucket requests already
+        waiting -- an int, or a callable ``bucket -> int`` (the bucket is
+        only known after validation, so a queue-owning caller passes its
+        depth lookup)."""
+        op = "hpd" if op == "cholesky" else op
+        if op not in ("lu", "hpd"):
+            return reject_doc("bad_request",
+                              detail=f"op must be 'lu' or 'hpd', got {op!r}")
+        A = np.asarray(A)
+        B = np.asarray(B)
+        if B.ndim == 1:
+            B = B[:, None]
+        if A.ndim != 2 or A.shape[0] != A.shape[1] or B.ndim != 2 \
+                or B.shape[0] != A.shape[0]:
+            return reject_doc("bad_request",
+                              detail=f"bad shapes A{A.shape} B{B.shape}")
+        if not np.issubdtype(A.dtype, np.inexact):
+            A = A.astype(np.float64)
+            B = B.astype(np.float64)
+        bucket = make_bucket(op, A.shape[0], B.shape[1], A.dtype)
+        if callable(queue_depth):
+            queue_depth = int(queue_depth(bucket))
+        if deadline is not None:
+            if deadline.expired():
+                return reject_doc("deadline_expired", bucket=bucket,
+                                  queue_depth=queue_depth, deadline=deadline)
+            if self.shed:
+                wait = self.estimated_wait_s(bucket, queue_depth)
+                if wait > deadline.remaining():
+                    return reject_doc(
+                        "queue_pressure", bucket=bucket,
+                        queue_depth=queue_depth, estimate_s=wait,
+                        deadline=deadline,
+                        detail=f"estimated wait {wait:.3g}s exceeds "
+                               f"remaining {deadline.remaining():.3g}s")
+        return SolveRequest(id=next(self._ids), op=op, A=A, B=B,
+                            bucket=bucket, deadline=deadline,
+                            submitted=self.clock())

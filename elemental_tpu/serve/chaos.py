@@ -1,0 +1,228 @@
+"""Chaos harness: the serve acceptance matrix under fault injection.
+
+Drives a :class:`~.service.SolverService` against the ISSUE-7
+:class:`~elemental_tpu.resilience.FaultPlan` machinery (seeded,
+bit-identically replayable) and CLASSIFIES every request's outcome, so
+the acceptance matrix
+
+    {bitflip, scale, nan} x {redistribute, compute} x {oneshot, persistent}
+
+is pinned as data: every fault is either
+
+  * **absorbed**  -- the request still ended ``ok`` within its deadline
+    (bisect re-execution ate a one-shot fault, or escalation repaired
+    it), with the independently recomputed residual under tol;
+  * **isolated**  -- the faulted request failed/timed out ALONE while
+    its batch-mates ended ``ok`` (zero collateral damage);
+  * **surfaced**  -- a structured failure (certificate with failing
+    phase / timed_out flag), never a silent garbage solution.
+
+Violations -- silent garbage (``ok`` whose trusted recomputed residual
+exceeds tol), collateral damage (a non-faulted batch-mate not ``ok`` in
+a one-shot cell), or an unstructured failure -- are collected per cell;
+a clean matrix has none.  ``python -m perf.serve chaos`` is the CLI /
+``tools/check.sh serve`` gate; ``tests/serve/test_chaos.py`` pins the
+matrix plus replay determinism in tier-1.
+
+Fault-target routing: ``compute`` cells run the BATCHED fast path (the
+executor's solve output crosses the compute seam -- call 0 is the first
+batch); ``redistribute`` / ``panel_spread`` cells run ``fastpath=False``
+so every request exercises the distributed certified path where the
+engine seams live (the big-problem serving mode).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..resilience.faults import (FAULT_KINDS, FaultPlan, FaultSpec,
+                                 logs_identical)
+from ..redist.engine import fault_injection
+from .executor import residual
+from .service import SolverService
+
+CHAOS_SCHEMA = "chaos_report/v1"
+
+#: the matrix's default target axis (panel_spread is covered by the
+#: resilience suite; serve adds the compute axis it introduced)
+CHAOS_TARGETS = ("redistribute", "compute")
+CHAOS_MODES = ("oneshot", "persistent")
+
+#: ops whose serve path exercises each target (overridable per cell)
+_OP_FOR_TARGET = {"redistribute": "lu", "panel_spread": "hpd",
+                  "compute": "hpd"}
+
+
+def build_workload(op: str, n: int, nrhs: int, count: int, seed: int,
+                   dtype=None):
+    """``count`` well-conditioned problems (same bucket), seeded.
+
+    ``dtype=None`` adapts to the runtime: float64 when jax x64 is
+    enabled (the test harness), float32 otherwise (plain CLI processes,
+    where float64 payloads would silently downcast and no residual could
+    meet a float64-class tolerance)."""
+    if dtype is None:
+        import jax
+        dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
+    dtype = np.dtype(dtype)
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(count):
+        F = rng.normal(size=(n, n))
+        A = F @ F.T / n + n * np.eye(n) if op == "hpd" \
+            else F + n * np.eye(n)
+        B = rng.normal(size=(n, nrhs))
+        out.append((A.astype(dtype), B.astype(dtype)))
+    return out
+
+
+def make_service(grid, *, fastpath: bool, requests: int,
+                 clock=None, sleep=None, **kw) -> SolverService:
+    """A chaos-shaped service: one batch holds the whole workload, no
+    shedding, a breaker too patient to interfere with the cell (breaker
+    dynamics have their own tests), near-zero backoff."""
+    skw = dict(max_batch=max(requests, 1), capacity=4 * max(requests, 1),
+               shed=False, fastpath=fastpath, breaker_threshold=99,
+               retries=1, backoff_base_s=0.0)
+    skw.update(kw)
+    if clock is not None:
+        skw["clock"] = clock
+    if sleep is not None:
+        skw["sleep"] = sleep
+    return SolverService(grid, **skw)
+
+
+def compute_slots(plan: FaultPlan, bucket_n: int, bucket_nrhs: int) -> set:
+    """Batch slots whose payload a ``compute`` fault event on the FIRST
+    batched dispatch touched (flat index -> leading batch axis)."""
+    hit = set()
+    per = bucket_n * bucket_nrhs
+    for ev in plan.log:
+        if ev.target == "compute" and len(ev.shape) == 3:
+            hit.update(int(i) // per for i in np.asarray(ev.indices))
+    return hit
+
+
+def run_cell(grid, *, kind: str, target: str, mode: str,
+             op: str | None = None, n: int = 16, nrhs: int = 2,
+             requests: int = 4, call: int = 0, nelem: int = 2,
+             seed: int = 13, budget_s: float | None = None,
+             service_kw: dict | None = None):
+    """One acceptance-matrix cell.  Returns ``(cell_doc, plan, service)``
+    -- the plan so callers can replay-compare logs, the service so tests
+    can poke solutions/metrics."""
+    op = op or _OP_FOR_TARGET[target]
+    fastpath = target == "compute"
+    svc = make_service(grid, fastpath=fastpath, requests=requests,
+                       **(service_kw or {}))
+    workload = build_workload(op, n, nrhs, requests, seed)
+    plan = FaultPlan(seed=seed, faults=[
+        FaultSpec(target, kind, call=call, every=(mode == "persistent"),
+                  nelem=nelem)])
+    ids = []
+    for A, B in workload:
+        rid = svc.submit(op, A, B, budget_s=budget_s)
+        assert not isinstance(rid, dict), f"chaos submit rejected: {rid}"
+        ids.append(rid)
+    with fault_injection(plan):
+        svc.drain()
+    return _classify(svc, plan, workload, ids, kind=kind, target=target,
+                     mode=mode, op=op, budget_s=budget_s), plan, svc
+
+
+def _classify(svc, plan, workload, ids, *, kind, target, mode, op,
+              budget_s):
+    outcomes = {}
+    violations = []
+    hit_slots = None
+    if target == "compute" and mode == "oneshot" and plan.log:
+        b = svc.results[ids[0]]["bucket"]        # all same bucket
+        bn, brhs = (int(x) for x in
+                    b.split("__b")[1].split("__")[0].split("x"))
+        hit_slots = compute_slots(plan, bn, brhs)
+    n_ok = 0
+    for slot, (rid, (A, B)) in enumerate(zip(ids, workload)):
+        doc = svc.results[rid]
+        st = doc["status"]
+        outcomes[rid] = st
+        if st == "ok":
+            n_ok += 1
+            X = svc.solutions.get(rid)
+            if X is None or residual(A, B, X) > doc["tol"]:
+                violations.append({"kind": "silent_garbage", "id": rid,
+                                   "detail": "ok result fails the trusted "
+                                             "recomputed residual"})
+        elif st == "failed":
+            if doc["certificate"] is None:
+                violations.append({"kind": "unstructured", "id": rid,
+                                   "detail": "failed without certificate"})
+        elif st == "timed_out":
+            if not doc["timed_out"]:
+                violations.append({"kind": "unstructured", "id": rid,
+                                   "detail": "timed_out without flag"})
+            cert = doc["certificate"]
+            if cert is not None and not cert["timed_out"] \
+                    and len(cert["attempts"]) >= len(cert["ladder"]):
+                violations.append({
+                    "kind": "overrun", "id": rid,
+                    "detail": "full ladder ran past an expired deadline"})
+        else:
+            violations.append({"kind": "unstructured", "id": rid,
+                               "detail": f"unexpected status {st!r}"})
+        # zero collateral damage: in a one-shot compute cell, a request
+        # whose batch slot the fault never touched must end ok
+        if hit_slots is not None and slot not in hit_slots and st != "ok":
+            violations.append({"kind": "collateral", "id": rid,
+                               "detail": f"untouched slot {slot} not ok"})
+    if mode == "oneshot" and len(ids) - n_ok > 1:
+        violations.append({"kind": "collateral",
+                           "detail": f"one-shot fault took down "
+                                     f"{len(ids) - n_ok} requests"})
+    verdict = "absorbed" if n_ok == len(ids) else \
+        ("isolated" if n_ok >= len(ids) - 1 and mode == "oneshot"
+         else "surfaced")
+    return {"kind": kind, "target": target, "mode": mode, "op": op,
+            "requests": len(ids), "ok": n_ok, "fired": plan.fired(),
+            "budget_s": budget_s, "outcomes": outcomes,
+            "verdict": verdict, "violations": violations}
+
+
+def chaos_matrix(grid, *, kinds=FAULT_KINDS, targets=CHAOS_TARGETS,
+                 modes=CHAOS_MODES, seed: int = 13, n: int = 16,
+                 requests: int = 4, **kw):
+    """The full acceptance matrix -> ``chaos_report/v1``."""
+    cells = []
+    nviol = 0
+    vacuous = 0
+    for target in targets:
+        for kind in kinds:
+            for mode in modes:
+                cell, plan, _ = run_cell(
+                    grid, kind=kind, target=target, mode=mode, seed=seed,
+                    n=n, requests=requests,
+                    call=2 if target == "redistribute" else 0, **kw)
+                if cell["fired"] == 0:
+                    vacuous += 1
+                    cell["violations"].append(
+                        {"kind": "vacuous",
+                         "detail": "fault never landed"})
+                nviol += len(cell["violations"])
+                cells.append(cell)
+    return {"schema": CHAOS_SCHEMA, "grid": [grid.height, grid.width],
+            "seed": seed, "cells": cells, "violations_total": nviol,
+            "vacuous_cells": vacuous, "ok": nviol == 0}
+
+
+def replay_identical(grid, *, kind: str = "bitflip",
+                     target: str = "compute", mode: str = "persistent",
+                     seed: int = 29, **kw) -> bool:
+    """Replay one cell twice with the same seed: bit-identical fault
+    logs AND identical per-request outcomes (the determinism oracle the
+    breaker/chaos tests build on)."""
+    c1, p1, _ = run_cell(grid, kind=kind, target=target, mode=mode,
+                         seed=seed, **kw)
+    c2, p2, _ = run_cell(grid, kind=kind, target=target, mode=mode,
+                         seed=seed, **kw)
+    same_outcomes = [c1["outcomes"][k] for k in sorted(c1["outcomes"])] \
+        == [c2["outcomes"][k] for k in sorted(c2["outcomes"])]
+    return logs_identical(p1, p2) and same_outcomes \
+        and c1["verdict"] == c2["verdict"]

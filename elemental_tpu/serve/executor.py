@@ -1,0 +1,181 @@
+"""Batched bucket executor: vmap'd solves + a persistent AOT-compiled
+executable cache.
+
+The throughput core of the solver service (ISSUE 9).  All requests in
+one :class:`~.admission.Bucket` are PADDED to the bucket's canonical
+geometry (the system embedded top-left, identity on the padded diagonal,
+zero right-hand sides -- the padded solution's extra rows are exactly
+zero, so truncation is lossless), stacked, and solved by ONE dispatch of
+a ``jax.vmap``'d Cholesky/LU kernel: hundreds of small systems amortize
+one launch, exactly the serving workload the ROADMAP names.
+
+No request ever pays compile: executables are AOT-lowered and compiled
+ONCE per ``(op, bucket, batch-slot, dtype, backend)`` key -- the same
+key vocabulary as ``tuning_cache/v1`` -- and cached for the life of the
+process (``serve_exec_cache/v1``; hits/misses/compiles are counted on
+the obs metrics registry as ``serve_exec_cache_events``).  Batch sizes
+are pow2-bucketed too (``batch_slots``), so a queue draining 3, 5, then
+6 requests reuses the 4- and 8-slot executables instead of compiling
+three shapes.
+
+The batch output routes through the engine's ``'compute'`` fault seam
+(:func:`~elemental_tpu.redist.engine.apply_fault`) before certification,
+so chaos tests can model a soft error in the batched local math -- the
+serve-side twin of the driver panel seams.
+
+Certification is the same TRUSTED measurement ``certified_solve`` uses:
+host-side float64 residuals per request (a corrupted executor can
+corrupt the solve, never the measurement).
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..obs import metrics as _metrics
+from ..redist.engine import apply_fault
+from .admission import Bucket
+
+EXEC_SCHEMA = "serve_exec_cache/v1"
+
+
+def batch_slots(k: int) -> int:
+    """Pow2 slot count for a batch of ``k`` requests (>= 1)."""
+    k = max(int(k), 1)
+    return 1 << (k - 1).bit_length()
+
+
+def pad_problem(A: np.ndarray, B: np.ndarray, bucket: Bucket):
+    """Embed one (n, n) system into the bucket's canonical geometry.
+
+    Returns ``(Ap, Bp)`` with ``Ap = [[A, 0], [0, I]]`` (nonsingular and
+    HPD-preserving by construction) and ``Bp = [[B], [0]]`` zero-padded
+    on both dims, so ``Xp[:n, :nrhs]`` IS the original solution."""
+    n, nrhs = A.shape[0], B.shape[1]
+    dt = np.dtype(bucket.dtype)
+    Ap = np.eye(bucket.n, dtype=dt)
+    Ap[:n, :n] = A
+    Bp = np.zeros((bucket.n, bucket.nrhs), dtype=dt)
+    Bp[:n, :nrhs] = B
+    return Ap, Bp
+
+
+def _kernel(op: str):
+    """The one-problem solve kernel ``(A, B) -> X`` that gets vmapped."""
+    import jax
+    import jax.numpy as jnp
+
+    if op == "lu":
+        def solve(a, b):
+            lu_, piv = jax.scipy.linalg.lu_factor(a)
+            return jax.scipy.linalg.lu_solve((lu_, piv), b)
+    elif op == "hpd":
+        def solve(a, b):
+            L = jnp.linalg.cholesky(a)
+            y = jax.scipy.linalg.solve_triangular(L, b, lower=True)
+            return jax.scipy.linalg.solve_triangular(
+                jnp.conj(L).T, y, lower=False)
+    else:
+        raise ValueError(f"executor op must be 'lu' or 'hpd', got {op!r}")
+    return solve
+
+
+class ExecutableCache:
+    """AOT-compiled batched solvers, keyed like ``tuning_cache/v1``.
+
+    One entry per ``(op, bucket, slots, dtype, backend)``; the first
+    request of a geometry pays ``lower().compile()`` ONCE, every later
+    batch calls the compiled executable directly.  In-process persistent
+    (executable serialization is backend-specific; the jax persistent
+    compilation cache makes cold processes cheap where available)."""
+
+    def __init__(self):
+        self._cache: dict = {}
+
+    @staticmethod
+    def key(op: str, bucket: Bucket, slots: int, backend: str) -> str:
+        return (f"{op}__b{bucket.n}x{bucket.nrhs}__x{slots}"
+                f"__{bucket.dtype}__{backend}")
+
+    def get(self, op: str, bucket: Bucket, slots: int):
+        """The compiled batched executable for this geometry."""
+        import jax
+
+        backend = jax.default_backend()
+        key = self.key(op, bucket, slots, backend)
+        hit = self._cache.get(key)
+        if hit is not None:
+            _metrics.inc("serve_exec_cache_events", op=op, event="hit")
+            return hit
+        _metrics.inc("serve_exec_cache_events", op=op, event="miss")
+        a = jax.ShapeDtypeStruct((slots, bucket.n, bucket.n),
+                                 np.dtype(bucket.dtype))
+        b = jax.ShapeDtypeStruct((slots, bucket.n, bucket.nrhs),
+                                 np.dtype(bucket.dtype))
+        compiled = jax.jit(jax.vmap(_kernel(op))).lower(a, b).compile()
+        _metrics.inc("serve_exec_cache_events", op=op, event="compile")
+        self._cache[key] = compiled
+        return compiled
+
+    def stats(self) -> dict:
+        return {"schema": EXEC_SCHEMA, "entries": sorted(self._cache)}
+
+    def clear(self) -> None:
+        self._cache.clear()
+
+
+class Executor:
+    """Runs padded batches through the cached executables."""
+
+    def __init__(self, *, clock=time.monotonic):
+        self.cache = ExecutableCache()
+        self.clock = clock
+
+    def run(self, bucket: Bucket, requests):
+        """Solve every request of one bucket in ONE batched dispatch.
+
+        Returns ``(xs, seconds)``: ``xs[i]`` is request i's UNPADDED host
+        solution (float64), ``seconds`` the wall-clock of the dispatch
+        (what the admission EWMA feeds on).  The batch output crosses the
+        ``'compute'`` fault seam before truncation."""
+        import jax
+        import jax.numpy as jnp
+
+        k = len(requests)
+        if k == 0:
+            return [], 0.0
+        slots = batch_slots(k)
+        dt = np.dtype(bucket.dtype)
+        a = np.broadcast_to(np.eye(bucket.n, dtype=dt),
+                            (slots, bucket.n, bucket.n)).copy()
+        b = np.zeros((slots, bucket.n, bucket.nrhs), dtype=dt)
+        for i, req in enumerate(requests):
+            a[i], b[i] = pad_problem(req.A, req.B, bucket)
+        compiled = self.cache.get(bucket.op, bucket, slots)
+        t0 = self.clock()
+        X = compiled(jnp.asarray(a), jnp.asarray(b))
+        X.block_until_ready()
+        seconds = self.clock() - t0
+        X, = apply_fault("compute", (X,))
+        Xh = np.asarray(X, dtype=np.float64)
+        xs = [Xh[i, :req.n, :req.nrhs] for i, req in enumerate(requests)]
+        _metrics.inc("serve_batches", op=bucket.op)
+        _metrics.inc("serve_batched_solves", k, op=bucket.op)
+        return xs, seconds
+
+
+def residual(A: np.ndarray, B: np.ndarray, X: np.ndarray) -> float:
+    """TRUSTED host-float64 normwise relative backward error -- the same
+    certificate measurement ``resilience.certify`` uses, computed from
+    the caller-held problem data (never the executor's arrays)."""
+    An = np.asarray(A, dtype=np.float64)
+    Bn = np.asarray(B, dtype=np.float64)
+    Xn = np.asarray(X, dtype=np.float64)
+    with np.errstate(over="ignore", invalid="ignore"):
+        den = (np.linalg.norm(An) * np.linalg.norm(Xn)
+               + np.linalg.norm(Bn))
+        if not np.isfinite(den) or den == 0.0:
+            return float("inf")
+        res = np.linalg.norm(Bn - An @ Xn) / den
+    return float(res) if np.isfinite(res) else float("inf")

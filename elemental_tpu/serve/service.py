@@ -1,0 +1,350 @@
+"""The solver service: deadline-bounded, batched, fault-isolated solves.
+
+The ISSUE-9 tentpole front-end gluing the serve layers together::
+
+    submit ->  admission (bucket, deadline, load shed, breaker gate)
+    drain  ->  executor  (padded vmap batch, AOT-compiled, one dispatch)
+           ->  certify   (trusted host residual per request)
+           ->  isolate   (bisect-split a failing batch: one poisoned
+                          problem fails ALONE, batch-mates still certify;
+                          re-execution absorbs one-shot faults)
+           ->  escalate  (retry/backoff around ``certified_solve`` with
+                          the deadline threaded and the load-aware
+                          degradation ladder)
+
+Every request ends in exactly one structured outcome -- ``serve_result/
+v1`` with status ``ok`` / ``failed`` / ``timed_out``, or a
+``serve_reject/v1`` at submit -- and every ``ok`` carries a residual
+measured on the TRUSTED host path: zero silent garbage by construction
+(the chaos matrix in ``tests/serve`` pins it under fault injection).
+
+The service is synchronous and deterministic: ``submit`` enqueues (or
+fast-rejects), ``drain`` processes the queue to completion.  An async
+front-end is one thread + this object; determinism (injectable clock +
+sleep, seeded jitter) is what makes the breaker/chaos tests replayable.
+
+Observability: per-request latency histograms, queue-depth / pressure /
+breaker gauges, and -- when an ``obs.Tracer`` is active -- one span per
+batch and per escalated request, riding the same ``phase_hook`` seam as
+the drivers.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..obs import metrics as _metrics
+from ..obs.tracer import active_tracer, phase_hook
+from ..resilience.certify import certified_solve, default_tol
+from .admission import AdmissionController, Bucket, Deadline, reject_doc
+from .executor import Executor, residual
+from .policy import (DEGRADE_PRESSURE, OPEN, CircuitBreaker, RetryPolicy,
+                     select_ladder)
+
+RESULT_SCHEMA = "serve_result/v1"
+
+
+class SolverService:
+    """See module docstring.  ``grid`` is the escalation grid (default:
+    the process default grid); ``fastpath=False`` routes every request
+    straight to the certified distributed path (the big-problem /
+    chaos-redist serving mode).  ``clock``/``sleep`` are injectable for
+    deterministic tests."""
+
+    def __init__(self, grid=None, *, max_batch: int = 8, capacity: int = 16,
+                 shed: bool = True, fastpath: bool = True,
+                 health: bool = True, seed: int = 0,
+                 breaker_threshold: int = 3, breaker_cooldown_s: float = 1.0,
+                 retries: int = 1, backoff_base_s: float = 0.05,
+                 degrade_pressure: float = DEGRADE_PRESSURE,
+                 escalate_nb: int | None = None, tol_factor: float = 1.0,
+                 flops_per_s: float | None = None,
+                 clock=time.monotonic, sleep=None):
+        self.grid = grid
+        self.max_batch = max(int(max_batch), 1)
+        self.capacity = max(int(capacity), 1)
+        self.fastpath = bool(fastpath)
+        self.health = bool(health)
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.degrade_pressure = float(degrade_pressure)
+        self.escalate_nb = escalate_nb
+        self.tol_factor = float(tol_factor)
+        self.clock = clock
+        self._sleep = sleep if sleep is not None else time.sleep
+        kw = {} if flops_per_s is None else {"flops_per_s": flops_per_s}
+        self.admission = AdmissionController(
+            shed=shed, max_batch=self.max_batch, clock=clock, **kw)
+        self.executor = Executor(clock=clock)
+        self.retry = RetryPolicy(retries=retries, base_s=backoff_base_s,
+                                 seed=seed)
+        self.breakers: dict = {}         # bucket.key() -> CircuitBreaker
+        self._queues: dict = {}          # Bucket -> [SolveRequest]
+        self.results: dict = {}          # id -> serve_result/v1
+        self.solutions: dict = {}        # id -> np.ndarray
+
+    # ---- bookkeeping -------------------------------------------------
+    def _grid(self):
+        if self.grid is None:
+            from ..core.grid import default_grid
+            self.grid = default_grid()
+        return self.grid
+
+    def breaker(self, bucket: Bucket) -> CircuitBreaker:
+        br = self.breakers.get(bucket.key())
+        if br is None:
+            br = self.breakers[bucket.key()] = CircuitBreaker(
+                bucket.key(), threshold=self.breaker_threshold,
+                cooldown_s=self.breaker_cooldown_s, clock=self.clock)
+        return br
+
+    def queue_depth(self, bucket: Bucket | None = None) -> int:
+        if bucket is not None:
+            return len(self._queues.get(bucket, ()))
+        return sum(len(q) for q in self._queues.values())
+
+    def pressure(self) -> float:
+        """Queue depth / capacity: the degradation + shedding signal."""
+        return self.queue_depth() / self.capacity
+
+    def _gauges(self) -> None:
+        _metrics.set_gauge("serve_queue_depth", self.queue_depth())
+        _metrics.set_gauge("serve_pressure", self.pressure())
+
+    def _tol(self, req) -> float:
+        return self.tol_factor * default_tol(req.n, req.A.dtype)
+
+    # ---- submit ------------------------------------------------------
+    def submit(self, op: str, A, B, *, budget_s: float | None = None,
+               deadline: Deadline | None = None):
+        """Admit one request.  Returns the request id (int) on accept or
+        a structured ``serve_reject/v1`` dict on fast reject (load shed,
+        expired deadline, open breaker, malformed request)."""
+        if deadline is None and budget_s is not None:
+            deadline = Deadline(budget_s, clock=self.clock)
+        req = self.admission.admit(op, A, B, deadline=deadline,
+                                   queue_depth=self.queue_depth)
+        if isinstance(req, dict):        # bad_request / expired / shed
+            _metrics.inc("serve_rejects", reason=req["reason"])
+            return req
+        bucket = req.bucket
+        br = self.breaker(bucket)
+        if br.state == OPEN:
+            # peek-only: the half-open probe slot belongs to QUEUED work,
+            # so an open breaker sheds new submissions without consuming it
+            elapsed_ok = br.opened_at is not None \
+                and self.clock() - br.opened_at >= br.cooldown_s
+            if not elapsed_ok:
+                rej = reject_doc("breaker_open", bucket=bucket,
+                                 queue_depth=self.queue_depth(bucket),
+                                 deadline=deadline,
+                                 detail=f"breaker open for {bucket.key()}")
+                _metrics.inc("serve_rejects", reason="breaker_open")
+                return rej
+        self._queues.setdefault(bucket, []).append(req)
+        self._gauges()
+        return req.id
+
+    # ---- drain -------------------------------------------------------
+    def drain(self) -> dict:
+        """Process the queue to completion; returns {id: result doc} for
+        everything finalized by this call."""
+        tm = phase_hook("serve")
+        tm.start()
+        done: dict = {}
+        before = set(self.results)
+        bi = 0
+        while self._queues:
+            # oldest head request picks the next bucket (FIFO fairness)
+            bucket = min(self._queues,
+                         key=lambda b: self._queues[b][0].submitted)
+            q = self._queues[bucket]
+            batch, self._queues[bucket] = q[:self.max_batch], \
+                q[self.max_batch:]
+            if not self._queues[bucket]:
+                del self._queues[bucket]
+            self._gauges()
+            self._run_batch(bucket, batch, tm, bi)
+            bi += 1
+        for rid, doc in self.results.items():
+            if rid not in before:
+                done[rid] = doc
+        self._gauges()
+        return done
+
+    def solve(self, op: str, A, B, *, budget_s: float | None = None):
+        """Convenience synchronous one-shot: submit + drain.  Returns
+        ``(X, doc)`` where doc is a result or reject document."""
+        rid = self.submit(op, A, B, budget_s=budget_s)
+        if isinstance(rid, dict):
+            return None, rid
+        self.drain()
+        return self.solutions.get(rid), self.results[rid]
+
+    # ---- the batch pipeline ------------------------------------------
+    def _run_batch(self, bucket: Bucket, reqs, tm, bi: int) -> None:
+        live = []
+        for req in reqs:
+            if req.deadline is not None and req.deadline.expired():
+                self._finalize(req, bucket, status="timed_out",
+                               path="dropped", timed_out=True)
+            else:
+                live.append(req)
+        if not live:
+            return
+        br = self.breaker(bucket)
+        if not (self.fastpath and br.allow()):
+            _metrics.inc("serve_fastpath_bypass", op=bucket.op)
+            for req in live:
+                self._escalate(bucket, req)
+            return
+        tr = active_tracer()
+        span = tr.span(f"serve:batch:{bucket.key()}", n=len(live)) \
+            if tr is not None else _null_cm()
+        with span:
+            xs, seconds = self.executor.run(bucket, live)
+        self.admission.observe_batch(bucket, seconds)
+        tm.tick("batch", bi)
+        passed, failed = self._certify(bucket, live, xs)
+        if failed:
+            br.record_failure()
+        else:
+            br.record_success()
+        if failed:
+            self._isolate(bucket, failed)
+
+    def _certify(self, bucket: Bucket, reqs, xs, path="fastpath"):
+        """Trusted per-request residuals; finalize passes, return fails."""
+        passed, failed = [], []
+        for req, X in zip(reqs, xs):
+            res = residual(req.A, req.B, X)
+            if res <= self._tol(req):
+                self._finalize(req, bucket, status="ok", path=path,
+                               rung="fastpath", residual=res, x=X)
+                passed.append(req)
+            else:
+                failed.append(req)
+        return passed, failed
+
+    def _isolate(self, bucket: Bucket, reqs, depth: int = 0) -> None:
+        """Bisect-split a failing group: fresh re-executions certify the
+        clean batch-mates (and absorb one-shot faults); a singleton gets
+        ONE fresh solo re-execution (the cheap transient-fault retry)
+        and only then escapes to the escalation ladder ALONE."""
+        if len(reqs) == 1:
+            if depth == 0:
+                # the batch itself was the singleton: no re-execution
+                # evidence yet, give it the solo retry too
+                xs, _ = self.executor.run(bucket, reqs)
+                _, failed = self._certify(bucket, reqs, xs)
+                if not failed:
+                    return
+            self._escalate(bucket, reqs[0], bisected=True)
+            return
+        _metrics.inc("serve_bisect_splits", op=bucket.op)
+        mid = (len(reqs) + 1) // 2
+        for half in (reqs[:mid], reqs[mid:]):
+            if not half:
+                continue
+            xs, _ = self.executor.run(bucket, half)
+            _, failed = self._certify(bucket, half, xs)
+            if failed:
+                if len(half) == 1:
+                    self._escalate(bucket, half[0], bisected=True)
+                else:
+                    self._isolate(bucket, failed, depth + 1)
+
+    # ---- escalation --------------------------------------------------
+    def _escalate(self, bucket: Bucket, req, bisected: bool = False) -> None:
+        tr = active_tracer()
+        span = tr.span(f"serve:req:{req.id}", op=req.op) \
+            if tr is not None else _null_cm()
+        with span:
+            self._escalate_inner(bucket, req, bisected)
+
+    def _escalate_inner(self, bucket, req, bisected: bool) -> None:
+        from ..core.dist import MC, MR
+        from ..core.distmatrix import from_global
+        if req.deadline is not None and req.deadline.expired():
+            self._finalize(req, bucket, status="timed_out", path="escalated",
+                           timed_out=True, bisected=bisected)
+            return
+        ladder = select_ladder(req.op, self.pressure(),
+                               self.degrade_pressure)
+        tol = self._tol(req)
+        g = self._grid()
+        retries = 0
+        cert = None
+        X = None
+        for attempt in range(self.retry.retries + 1):
+            Ad = from_global(req.A, MC, MR, grid=g)
+            Bd = from_global(req.B, MC, MR, grid=g)
+            Xd, cert = certified_solve(req.op, Ad, Bd, tol=tol,
+                                       nb=self.escalate_nb, ladder=ladder,
+                                       health=self.health,
+                                       deadline=req.deadline)
+            X = None if Xd is None else np.asarray(
+                _to_host(Xd), dtype=np.float64)
+            _metrics.inc("serve_escalations", op=req.op,
+                         rung=str(cert["rung"]))
+            if cert["certified"]:
+                self._finalize(req, bucket, status="ok", path="escalated",
+                               rung=cert["rung"], residual=cert["residual"],
+                               x=X, certificate=cert, retries=retries,
+                               bisected=bisected)
+                return
+            if cert["timed_out"]:
+                break
+            if attempt < self.retry.retries:
+                delay = self.retry.delay_s(req.id, attempt + 1,
+                                           req.deadline)
+                if delay < 0.0:
+                    break                # no budget left for a retry
+                if delay > 0.0:
+                    self._sleep(delay)
+                retries += 1
+                _metrics.inc("serve_retries", op=req.op)
+        timed_out = bool(cert is not None and cert["timed_out"])
+        self._finalize(req, bucket,
+                       status="timed_out" if timed_out else "failed",
+                       path="escalated", rung=None,
+                       residual=None if cert is None else cert["residual"],
+                       x=X, certificate=cert, retries=retries,
+                       timed_out=timed_out, bisected=bisected)
+
+    # ---- finalize ----------------------------------------------------
+    def _finalize(self, req, bucket: Bucket, *, status: str, path: str,
+                  rung: str | None = None, residual: float | None = None,
+                  x=None, certificate: dict | None = None, retries: int = 0,
+                  timed_out: bool = False, bisected: bool = False) -> None:
+        latency = self.clock() - req.submitted
+        doc = {"schema": RESULT_SCHEMA, "id": req.id, "op": req.op,
+               "n": req.n, "nrhs": req.nrhs, "bucket": bucket.key(),
+               "status": status, "path": path, "rung": rung,
+               "residual": residual, "tol": self._tol(req),
+               "retries": int(retries), "bisected": bool(bisected),
+               "timed_out": bool(timed_out), "latency_s": float(latency),
+               "deadline": req.deadline.to_doc()
+               if req.deadline is not None else None,
+               "certificate": certificate,
+               "breaker": self.breaker(bucket).state}
+        self.results[req.id] = doc
+        if x is not None and status == "ok":
+            self.solutions[req.id] = x
+        _metrics.inc("serve_requests", op=req.op, status=status)
+        _metrics.observe("serve_latency_seconds", float(latency),
+                         op=req.op)
+
+
+def _to_host(Xd):
+    from ..core.distmatrix import to_global
+    return to_global(Xd)
+
+
+class _null_cm:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

@@ -1,0 +1,214 @@
+"""Solver-service CLI (ISSUE 9): run, smoke-test, or chaos-test the
+batched serving front-end.
+
+The command-line face of ``elemental_tpu/serve``:
+
+    python -m perf.serve run --requests 32 --n 96 --grid 2x2
+                                            # drive a mixed workload
+                                            #   through SolverService;
+                                            #   per-request summary rows
+                                            #   (#-prefixed) + one JSON
+                                            #   tally line on stdout
+    python -m perf.serve run --budget 0.5 --fault redistribute:nan:2:every
+                                            # deadline-bounded requests
+                                            #   under fault injection
+    python -m perf.serve smoke              # the tools/check.sh gate:
+                                            #   mixed-size serving on 1x1
+                                            #   AND 2x2 grids, all ok,
+                                            #   exec-cache reuse proven;
+                                            #   exit 1 on any failure
+    python -m perf.serve chaos              # the acceptance matrix
+                                            #   {bitflip,scale,nan} x
+                                            #   {redistribute,compute} x
+                                            #   {oneshot,persistent}:
+                                            #   chaos_report/v1 on stdout,
+                                            #   exit 1 on any violation
+
+Runs are CPU-safe (same virtual 8-device mesh as ``perf.trace``);
+float32 workloads so certification tolerances match the unforced-x64
+CLI environment.  ``--fault`` shares ``perf.certify``'s
+``target:kind:call[:every]`` syntax, now including the ``compute``
+target.
+"""
+import json
+import sys
+
+from .trace import _bootstrap, _grid
+from .certify import _parse_fault
+
+
+def _workload(rng, count, n):
+    """Mixed lu/hpd problems around size n (two adjacent buckets)."""
+    import numpy as np
+    out = []
+    for i in range(count):
+        op = "lu" if i % 2 else "hpd"
+        ni = n if i % 3 else max(8, (3 * n) // 4)
+        F = rng.normal(size=(ni, ni)).astype(np.float32)
+        A = (F @ F.T / ni + ni * np.eye(ni)).astype(np.float32) \
+            if op == "hpd" else F + ni * np.eye(ni, dtype=np.float32)
+        B = rng.normal(size=(ni, 2)).astype(np.float32)
+        out.append((op, A, B))
+    return out
+
+
+def _tally(svc, docs) -> dict:
+    st: dict = {}
+    for doc in docs.values():
+        st[doc["status"]] = st.get(doc["status"], 0) + 1
+    lat = sorted(d["latency_s"] for d in docs.values())
+    return {"schema": "serve_run/v1", "requests": len(docs), "status": st,
+            "p50_ms": 1e3 * lat[len(lat) // 2] if lat else None,
+            "p99_ms": 1e3 * lat[min(len(lat) - 1,
+                                    (99 * len(lat)) // 100)] if lat else None,
+            "exec_cache": svc.executor.cache.stats()}
+
+
+def cmd_run(requests, n, grid_spec, budget, faults, seed, fastpath) -> int:
+    import numpy as np
+    from elemental_tpu.resilience import FaultPlan, fault_injection
+    from elemental_tpu.serve import SolverService
+    grid = _grid(grid_spec)
+    svc = SolverService(grid, fastpath=fastpath)
+    rng = np.random.default_rng(seed)
+    rejects = 0
+    for op, A, B in _workload(rng, requests, n):
+        rid = svc.submit(op, A, B, budget_s=budget)
+        if isinstance(rid, dict):
+            rejects += 1
+            print(f"# reject: {rid['reason']} bucket={rid['bucket']}")
+    if faults:
+        plan = FaultPlan(seed=seed, faults=faults)
+        with fault_injection(plan):
+            docs = svc.drain()
+        print(f"# faults fired: {plan.fired()}")
+    else:
+        docs = svc.drain()
+    for rid in sorted(docs):
+        d = docs[rid]
+        res = d["residual"]
+        print(f"# req {rid:3d} {d['op']:3s} n={d['n']:5d} "
+              f"{d['status']:9s} path={d['path']:9s} "
+              f"rung={str(d['rung']):8s} "
+              f"residual={'-' if res is None else format(res, '.2e')} "
+              f"latency={1e3 * d['latency_s']:.2f}ms")
+    tally = _tally(svc, docs)
+    tally["rejects"] = rejects
+    print(json.dumps(tally))
+    bad = sum(1 for d in docs.values()
+              if d["status"] not in ("ok", "failed", "timed_out"))
+    return 1 if bad else 0
+
+
+def cmd_smoke() -> int:
+    """The check.sh gate: mixed-size workloads must ALL certify on the
+    fast path on 1x1 and 2x2 grids, the executable cache must be reused
+    (second drain of the same geometry compiles nothing), and one
+    escalated solve must certify end-to-end."""
+    import numpy as np
+    from elemental_tpu.obs import metrics as _metrics
+    from elemental_tpu.serve import SolverService
+    rc = 0
+    for spec in ("1x1", "2x2"):
+        grid = _grid(spec)
+        svc = SolverService(grid)
+        rng = np.random.default_rng(0)
+        with _metrics.scoped() as reg:
+            for op, A, B in _workload(rng, 8, 48):
+                rid = svc.submit(op, A, B)
+                if isinstance(rid, dict):
+                    print(f"# smoke {spec}: unexpected reject {rid}")
+                    rc = 1
+            docs = svc.drain()
+            ok = sum(d["status"] == "ok" for d in docs.values())
+            # same geometries again: every batch must hit the exec cache
+            for op, A, B in _workload(rng, 8, 48):
+                svc.submit(op, A, B)
+            docs2 = svc.drain()
+            ok2 = sum(d["status"] == "ok" for d in docs2.values())
+            compiles = sum(v for (name, labels), v in reg.counters(
+                "serve_exec_cache_events").items()
+                if dict(labels).get("event") == "compile")
+            hits = sum(v for (name, labels), v in reg.counters(
+                "serve_exec_cache_events").items()
+                if dict(labels).get("event") == "hit")
+        print(f"# smoke {spec}: ok={ok}/8 + {ok2}/8 "
+              f"exec compiles={compiles} hits={hits}")
+        if ok != 8 or ok2 != 8 or hits == 0:
+            rc = 1
+    # escalated path: fastpath off, must certify through certified_solve
+    grid = _grid("2x2")
+    svc = SolverService(grid, fastpath=False)
+    rng = np.random.default_rng(1)
+    F = rng.normal(size=(32, 32)).astype(np.float32)
+    X, doc = svc.solve("lu", F + 32 * np.eye(32, dtype=np.float32),
+                       rng.normal(size=(32, 2)).astype(np.float32))
+    print(f"# smoke escalate: status={doc['status']} rung={doc['rung']}")
+    if doc["status"] != "ok" or doc["path"] != "escalated":
+        rc = 1
+    print("# serve smoke:", "ok" if rc == 0 else "FAILED")
+    return rc
+
+
+def cmd_chaos(seed) -> int:
+    from elemental_tpu.serve import chaos_matrix, replay_identical
+    grid = _grid("2x2")
+    report = chaos_matrix(grid, seed=seed)
+    for cell in report["cells"]:
+        print(f"# {cell['target']:12s} {cell['kind']:8s} "
+              f"{cell['mode']:10s} -> {cell['verdict']:9s} "
+              f"ok={cell['ok']}/{cell['requests']} fired={cell['fired']} "
+              f"violations={len(cell['violations'])}")
+    replay = replay_identical(grid, seed=seed + 16)
+    print(f"# replay deterministic: {replay}")
+    print(json.dumps(report))
+    ok = report["ok"] and replay
+    print("# serve chaos:", "ok" if ok else "FAILED")
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    cmd = argv.pop(0)
+    if cmd not in ("run", "smoke", "chaos"):
+        print(__doc__)
+        raise SystemExit(f"unknown command {cmd!r}")
+    requests, n, budget = 16, 64, None
+    grid_spec = None
+    seed = 0
+    fastpath = True
+    faults = []
+    it = iter(argv)
+    for arg in it:
+        if arg == "--requests":
+            requests = int(next(it))
+        elif arg == "--n":
+            n = int(next(it))
+        elif arg == "--grid":
+            grid_spec = next(it)
+        elif arg == "--budget":
+            budget = float(next(it))
+        elif arg == "--seed":
+            seed = int(next(it))
+        elif arg == "--fault":
+            faults.append(next(it))
+        elif arg == "--no-fastpath":
+            fastpath = False
+        elif arg.startswith("--"):
+            raise SystemExit(f"unknown flag {arg!r}")
+        else:
+            raise SystemExit(f"unexpected argument {arg!r}")
+    _bootstrap()
+    if cmd == "smoke":
+        return cmd_smoke()
+    if cmd == "chaos":
+        return cmd_chaos(seed)
+    fspecs = tuple(_parse_fault(s) for s in faults)
+    return cmd_run(requests, n, grid_spec, budget, fspecs, seed, fastpath)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

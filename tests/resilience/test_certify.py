@@ -97,7 +97,8 @@ def test_certificate_schema_pin(grid24):
     assert info["schema"] == CERT_SCHEMA
     assert set(info) == {"schema", "op", "certified", "rung", "residual",
                          "tol", "refine_iters", "ladder", "attempts",
-                         "singular", "failing_phase", "health"}
+                         "singular", "timed_out", "failing_phase", "health"}
+    assert info["timed_out"] is False
     assert info["ladder"] == list(LADDER_NAMES)
     att = info["attempts"][0]
     assert set(att) == {"rung", "residual", "refine_iters", "singular",
@@ -209,3 +210,77 @@ def test_solve_info_default_unchanged(grid24):
     Sn, _ = _problem(rng, 16, op="hpd")
     X2 = el.hpd_solve(_dist(grid24, Sn), _dist(grid24, Bn), nb=8)
     assert isinstance(X2, DistMatrix)
+
+
+# ---------------------------------------------------------------------
+# SATELLITE (ISSUE 9): deadline-bounded certification -- exhausted
+# budget returns best-so-far with timed_out, never the silent full ladder
+# ---------------------------------------------------------------------
+
+class _Clock:
+    """Manually advanced fake clock (and a per-call ticking variant)."""
+
+    def __init__(self, tick=0.0):
+        self.t = 0.0
+        self.tick = tick
+
+    def __call__(self):
+        self.t += self.tick
+        return self.t
+
+
+def test_deadline_pre_expired_no_attempts(grid24):
+    from elemental_tpu.serve import Deadline
+    rng = np.random.default_rng(110)
+    An, Bn = _problem(rng, 16)
+    clk = _Clock()
+    dl = Deadline(1.0, clock=clk)
+    clk.t = 5.0
+    X, info = certified_solve("lu", _dist(grid24, An), _dist(grid24, Bn),
+                              nb=8, deadline=dl)
+    assert info["certified"] is False
+    assert info["timed_out"] is True
+    assert info["attempts"] == [] and X is None
+    assert info["failing_phase"] == "deadline"
+    assert info["residual"] is None
+
+
+def test_deadline_mid_ladder_best_so_far(grid24):
+    """tol=0 would normally run EVERY rung; a deadline expiring after
+    the first rung stops the ladder there, returns the best-so-far
+    solution, and stamps timed_out -- strictly fewer attempts than the
+    undeadlined run."""
+    from elemental_tpu.serve import Deadline
+    rng = np.random.default_rng(111)
+    An, Bn = _problem(rng, 16)
+    A, B = _dist(grid24, An), _dist(grid24, Bn)
+    _, full = certified_solve("lu", A, B, nb=8, tol=0.0)
+    assert [a["rung"] for a in full["attempts"]] == list(LADDER_NAMES)
+    clk = _Clock(tick=0.3)               # every remaining() check costs 0.3
+    dl = Deadline(1.0, clock=clk)
+    X, info = certified_solve("lu", A, B, nb=8, tol=0.0, deadline=dl)
+    assert info["certified"] is False and info["timed_out"] is True
+    assert 0 < len(info["attempts"]) < len(LADDER_NAMES)
+    assert info["failing_phase"] == "deadline"
+    # best-so-far: the returned X is real and useful (tol=0 is
+    # unreachable and the deadline also cut refinement short, so this is
+    # the quant rung's partially-refined answer, not fp64-class)
+    assert X is not None
+    assert _clean_resid(An, Bn, X) < 1e-6
+    assert info["residual"] == pytest.approx(
+        min(a["residual"] for a in info["attempts"]
+            if a["residual"] is not None))
+
+
+def test_deadline_loose_budget_is_inert(grid24):
+    """A generous deadline changes nothing: same rung, certified, no
+    timed_out flag."""
+    from elemental_tpu.serve import Deadline
+    rng = np.random.default_rng(112)
+    An, Bn = _problem(rng, 16)
+    A, B = _dist(grid24, An), _dist(grid24, Bn)
+    _, base = certified_solve("lu", A, B, nb=8)
+    X, info = certified_solve("lu", A, B, nb=8,
+                              deadline=Deadline(3600.0))
+    assert info["certified"] is True and info["timed_out"] is False
+    assert info["rung"] == base["rung"]

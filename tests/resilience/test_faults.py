@@ -215,3 +215,109 @@ def test_persistent_corruption_surfaced_with_phase(grid24):
     assert info["health"] is not None
     assert [a["rung"] for a in info["attempts"]] \
         == ["quant", "fast", "refine", "fp32", "classic"]
+
+
+# ---------------------------------------------------------------------
+# SATELLITE (ISSUE 9): the 'compute' fault target -- local panel-kernel
+# outputs corrupted through engine.apply_fault, same seeded bit-identical
+# replay contract as the collective targets
+# ---------------------------------------------------------------------
+
+def test_compute_target_registered():
+    from elemental_tpu.resilience import FAULT_TARGETS
+    assert FAULT_TARGETS == ("redistribute", "panel_spread", "compute")
+    FaultSpec("compute", "nan")          # validates
+    # appending 'compute' must NOT have moved the original targets' seed
+    # words (the determinism contract of recorded plans)
+    from elemental_tpu.resilience.faults import _TARGET_WORD
+    assert _TARGET_WORD["redistribute"] == 1
+    assert _TARGET_WORD["panel_spread"] == 2
+    assert _TARGET_WORD["compute"] == 3
+
+
+@pytest.mark.parametrize("driver", ["lu", "cholesky", "qr"])
+def test_compute_fault_corrupts_local_panel(grid24, driver):
+    """A compute-target fault lands in the driver's LOCAL panel kernel
+    output (no engine payload involved) and propagates into the factor;
+    outside the context the driver is untouched."""
+    rng = np.random.default_rng(120)
+    n = 16
+    arr = rng.normal(size=(n, n)) + n * np.eye(n)
+    if driver == "cholesky":
+        arr = arr @ arr.T / n + n * np.eye(n)
+
+    def run():
+        A = _dist(grid24, arr)
+        if driver == "lu":
+            return np.asarray(to_global(el.lu(A, nb=8)[0]))
+        if driver == "qr":
+            return np.asarray(to_global(el.qr(A, nb=8)[0]))
+        return np.asarray(to_global(el.cholesky(A, nb=8)))
+
+    clean = run()
+    plan = FaultPlan(seed=9, faults=[FaultSpec("compute", "nan", call=0,
+                                               nelem=2)])
+    with fault_injection(plan):
+        dirty = run()
+    after = run()
+    assert plan.fired() >= 1
+    assert all(ev.target == "compute" for ev in plan.log)
+    assert not np.array_equal(clean, dirty)
+    np.testing.assert_array_equal(clean, after)
+
+
+def test_compute_fault_replay_bit_identical(grid24):
+    rng = np.random.default_rng(121)
+    arr = rng.normal(size=(16, 16)) + 16 * np.eye(16)
+
+    def run(plan):
+        # crossover=0: both panels stay in the distributed loop (the
+        # tail finish would otherwise absorb panel 1 locally)
+        with fault_injection(plan):
+            LU, _ = el.lu(_dist(grid24, arr), nb=8, crossover=0)
+        return np.asarray(to_global(LU))
+
+    mk = lambda: FaultPlan(seed=77, faults=[
+        FaultSpec("compute", "bitflip", call=0, every=True, nelem=2)])
+    p1, p2 = mk(), mk()
+    d1, d2 = run(p1), run(p2)
+    assert p1.fired() >= 2               # one per panel at nb=8, n=16
+    assert logs_identical(p1, p2)
+    np.testing.assert_array_equal(d1, d2)
+
+
+def test_compute_vs_redistribute_streams_differ(grid24):
+    """Same seed, same call index: the compute target draws from its OWN
+    seed stream (target word), not redistribute's."""
+    rng = np.random.default_rng(122)
+    arr = rng.normal(size=(16, 16)) + 16 * np.eye(16)
+    logs = {}
+    for target in ("compute", "redistribute"):
+        plan = FaultPlan(seed=55, faults=[FaultSpec(target, "bitflip",
+                                                    call=0, nelem=3)])
+        with fault_injection(plan):
+            el.lu(_dist(grid24, arr), nb=8)
+        assert plan.fired() == 1
+        logs[target] = plan.log[0]
+    ea, eb = logs["compute"], logs["redistribute"]
+    assert not (ea.shape == eb.shape
+                and np.array_equal(ea.indices, eb.indices)
+                and ea.after.tobytes() == eb.after.tobytes())
+
+
+@pytest.mark.parametrize("mode", ["oneshot", "persistent"])
+def test_compute_fault_matrix_certified_or_surfaced(grid24, mode):
+    """certified_solve over a compute-corrupted LOCAL kernel: same
+    no-silent-garbage invariant as the engine targets."""
+    rng = np.random.default_rng(123)
+    An, Bn = _problem(rng, 24, "lu")
+    A, B = _dist(grid24, An), _dist(grid24, Bn)
+    plan = FaultPlan(seed=13, faults=[FaultSpec(
+        "compute", "nan", call=0, every=(mode == "persistent"), nelem=2)])
+    with fault_injection(plan):
+        X, info = certified_solve("lu", A, B, nb=8)
+    assert plan.fired() > 0
+    if info["certified"]:
+        assert _clean_resid(An, Bn, X) <= info["tol"]
+    else:
+        assert info["failing_phase"] is not None

@@ -161,13 +161,13 @@ def test_tracer_instant_events(grid24):
 # unchanged; comm-plan goldens are covered by tests/analysis)
 # ---------------------------------------------------------------------
 
-@pytest.mark.parametrize("driver", ["lu", "cholesky"])
+@pytest.mark.parametrize("driver", ["lu", "cholesky", "qr"])
 def test_health_off_redist_counts_unchanged(grid24, driver, redist_counter):
     rng = np.random.default_rng(79)
     n = 24
     arr = _spd(rng, n) if driver == "cholesky" else \
         rng.normal(size=(n, n)) + n * np.eye(n)
-    fn = el.cholesky if driver == "cholesky" else el.lu
+    fn = getattr(el, driver)
     from elemental_tpu.redist.engine import redist_counts
     with redist_counts() as off:
         fn(_dist(grid24, arr), nb=8)
@@ -199,3 +199,59 @@ def test_monitor_reuse_resets(grid24):
     el.lu(_dist(grid24, rng.normal(size=(16, 16)) + 16 * np.eye(16)),
           nb=8, health=mon)
     assert mon.report()["ok"] is True
+
+
+# ---------------------------------------------------------------------
+# SATELLITE (ISSUE 9): qr(..., health=) parity with lu/cholesky --
+# NaN/Inf scans on panel/update ticks + near-zero R-diagonal detection
+# ---------------------------------------------------------------------
+
+def test_qr_clean_report_ok(grid24):
+    rng = np.random.default_rng(130)
+    mon = HealthMonitor()
+    el.qr(_dist(grid24, rng.normal(size=(24, 24))), nb=8, health=mon)
+    rep = mon.report()
+    assert rep["schema"] == HEALTH_SCHEMA
+    assert rep["driver"] == "qr" and rep["ok"] is True
+    assert rep["checks"] > 0
+    assert rep["min_diag"] is not None and rep["min_diag"] > 0
+    assert rep["growth_estimate"] is not None
+
+
+@pytest.mark.parametrize("panel", ["classic", "tsqr"])
+def test_qr_nan_input_flags_nonfinite(grid24, panel):
+    rng = np.random.default_rng(131)
+    F = rng.normal(size=(24, 24))
+    F[3, 5] = np.nan
+    mon = HealthMonitor()
+    el.qr(_dist(grid24, F), nb=8, panel=panel, health=mon)
+    rep = mon.report()
+    assert rep["ok"] is False
+    assert any(fl["kind"] == "nonfinite" for fl in rep["flags"])
+    assert rep["failing_phase"] in ("panel", "update")
+
+
+def test_qr_rank_deficiency_flags_small_rdiag(grid24):
+    """A rank-deficient input's R diagonal hits (near-)zero: the packed
+    panel diagonal check flags it as small_pivot -- the QR image of the
+    LU near-zero-pivot guard."""
+    rng = np.random.default_rng(132)
+    F = rng.normal(size=(24, 24))
+    F[:, 13] = F[:, 4]                   # duplicated column: rank 23
+    mon = HealthMonitor()
+    el.qr(_dist(grid24, F), nb=8, health=mon)
+    rep = mon.report()
+    assert rep["ok"] is False
+    flags = [fl for fl in rep["flags"] if fl["kind"] == "small_pivot"]
+    assert flags and flags[0]["phase"] == "panel"
+    # the clean sibling does not flag
+    mon2 = HealthMonitor()
+    el.qr(_dist(grid24, rng.normal(size=(24, 24))), nb=8, health=mon2)
+    assert mon2.report()["ok"] is True
+
+
+def test_qr_health_true_lands_in_last_report(grid24):
+    rng = np.random.default_rng(133)
+    el.qr(_dist(grid24, rng.normal(size=(16, 16))), nb=8, health=True)
+    rep = last_health_report("qr")
+    assert rep is not None and rep["driver"] == "qr"

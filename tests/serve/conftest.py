@@ -1,0 +1,35 @@
+"""Shared helpers for the solver-service tests (ISSUE 9)."""
+import numpy as np
+import pytest
+
+
+class FakeClock:
+    """A manually advanced clock: deterministic deadlines/breakers."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += float(dt)
+        return self.t
+
+    def sleep(self, dt: float) -> None:
+        """Injectable ``sleep=``: advancing the clock IS sleeping."""
+        self.advance(dt)
+
+
+@pytest.fixture
+def fake_clock():
+    return FakeClock()
+
+
+def spd(rng, n: int) -> np.ndarray:
+    F = rng.normal(size=(n, n))
+    return F @ F.T / n + n * np.eye(n)
+
+
+def diag_dom(rng, n: int) -> np.ndarray:
+    return rng.normal(size=(n, n)) + n * np.eye(n)

@@ -1,0 +1,164 @@
+"""Admission layer (ISSUE 9): deadlines, tuner-aligned bucketing, load
+shedding, structured rejects."""
+import numpy as np
+import pytest
+
+from elemental_tpu.serve import admission as adm
+from elemental_tpu.serve import AdmissionController, Deadline, make_bucket
+
+from .conftest import diag_dom
+
+
+# ---------------------------------------------------------------------
+# Deadline
+# ---------------------------------------------------------------------
+
+def test_deadline_budget_elapsed_remaining(fake_clock):
+    dl = Deadline(2.0, clock=fake_clock)
+    assert dl.elapsed() == 0.0 and dl.remaining() == 2.0
+    assert not dl.expired()
+    fake_clock.advance(1.5)
+    assert dl.elapsed() == pytest.approx(1.5)
+    assert dl.remaining() == pytest.approx(0.5)
+    fake_clock.advance(1.0)
+    assert dl.expired() and dl.remaining() == pytest.approx(-0.5)
+    doc = dl.to_doc()
+    assert set(doc) == {"budget_s", "elapsed_s", "remaining_s"}
+    assert doc["budget_s"] == 2.0
+
+
+# ---------------------------------------------------------------------
+# bucketing: pow2 per dim, EXACTLY the tuner's shape_bucket
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("n,nrhs,bn,brhs", [
+    (100, 3, 128, 4), (16, 2, 16, 2), (17, 1, 32, 1), (1, 1, 1, 1),
+    (2048, 5, 2048, 8),
+])
+def test_bucket_pow2(n, nrhs, bn, brhs):
+    b = make_bucket("lu", n, nrhs, np.float32)
+    assert (b.n, b.nrhs) == (bn, brhs)
+    assert b.dtype == "float32"
+    from elemental_tpu.tune.cache import shape_bucket
+    assert (b.n, b.nrhs) == shape_bucket((n, nrhs))
+
+
+def test_bucket_key_and_flops():
+    b = make_bucket("hpd", 100, 2, np.float64)
+    assert b.key() == "hpd__b128x2__float64"
+    # hpd factor ~ n^3/3, lu ~ 2n^3/3
+    blu = make_bucket("lu", 100, 2, np.float64)
+    assert blu.solve_flops() > b.solve_flops()
+
+
+# ---------------------------------------------------------------------
+# admit: validation, rejects, shedding
+# ---------------------------------------------------------------------
+
+def test_admit_happy_path_ids_increment(fake_clock):
+    ctrl = AdmissionController(clock=fake_clock)
+    rng = np.random.default_rng(0)
+    A = diag_dom(rng, 12)
+    B = rng.normal(size=(12, 2))
+    r1 = ctrl.admit("lu", A, B)
+    r2 = ctrl.admit("cholesky", A @ A.T, B)    # alias -> hpd
+    assert (r1.id, r2.id) == (0, 1)
+    assert r2.op == "hpd"
+    assert r1.bucket.key() == "lu__b16x2__float64"
+    assert r1.n == 12 and r1.nrhs == 2
+
+
+def test_admit_promotes_vector_rhs():
+    rng = np.random.default_rng(1)
+    ctrl = AdmissionController()
+    req = ctrl.admit("lu", diag_dom(rng, 8), rng.normal(size=8))
+    assert req.B.shape == (8, 1)
+
+
+def test_admit_bad_request_structured():
+    ctrl = AdmissionController()
+    rng = np.random.default_rng(2)
+    rej = ctrl.admit("qr", diag_dom(rng, 8), rng.normal(size=(8, 1)))
+    assert rej["schema"] == adm.REJECT_SCHEMA
+    assert rej["reason"] == "bad_request"
+    rej2 = ctrl.admit("lu", rng.normal(size=(8, 4)), rng.normal(size=(8, 1)))
+    assert rej2["reason"] == "bad_request"
+    rej3 = ctrl.admit("lu", diag_dom(rng, 8), rng.normal(size=(6, 1)))
+    assert rej3["reason"] == "bad_request"
+
+
+def test_admit_expired_deadline_rejects(fake_clock):
+    ctrl = AdmissionController(clock=fake_clock)
+    rng = np.random.default_rng(3)
+    dl = Deadline(1.0, clock=fake_clock)
+    fake_clock.advance(2.0)
+    rej = ctrl.admit("lu", diag_dom(rng, 8), rng.normal(size=(8, 1)),
+                     deadline=dl)
+    assert rej["reason"] == "deadline_expired"
+    assert rej["deadline"]["remaining_s"] == pytest.approx(-1.0)
+
+
+def test_load_shedding_queue_pressure(fake_clock):
+    """queue depth x bucket estimate > remaining budget => reject-fast
+    with the estimate in the document; shed=False admits anyway."""
+    rng = np.random.default_rng(4)
+    A, B = diag_dom(rng, 8), rng.normal(size=(8, 1))
+    # 1 flop/s: any queue wait estimate dwarfs any budget
+    ctrl = AdmissionController(clock=fake_clock, flops_per_s=1.0,
+                               max_batch=4)
+    dl = Deadline(10.0, clock=fake_clock)
+    rej = ctrl.admit("lu", A, B, deadline=dl, queue_depth=7)
+    assert rej["reason"] == "queue_pressure"
+    assert rej["estimate_s"] > 10.0
+    assert rej["queue_depth"] == 7
+    # no deadline => nothing to shed against
+    assert not isinstance(ctrl.admit("lu", A, B, queue_depth=7), dict)
+    # shedding disabled => admitted despite the hopeless estimate
+    loose = AdmissionController(clock=fake_clock, flops_per_s=1.0,
+                                shed=False)
+    assert not isinstance(
+        loose.admit("lu", A, B, deadline=Deadline(10.0, clock=fake_clock),
+                    queue_depth=7), dict)
+
+
+def test_queue_depth_callable_resolved_per_bucket(fake_clock):
+    ctrl = AdmissionController(clock=fake_clock, flops_per_s=1.0)
+    rng = np.random.default_rng(5)
+    seen = []
+
+    def depth(bucket):
+        seen.append(bucket.key())
+        return 3
+
+    rej = ctrl.admit("lu", diag_dom(rng, 8), rng.normal(size=(8, 1)),
+                     deadline=Deadline(1.0, clock=fake_clock),
+                     queue_depth=depth)
+    assert rej["reason"] == "queue_pressure"
+    assert seen == ["lu__b8x1__float64"]
+
+
+# ---------------------------------------------------------------------
+# cost model: cold flops seed -> measured EWMA
+# ---------------------------------------------------------------------
+
+def test_estimate_cold_then_ewma():
+    ctrl = AdmissionController(max_batch=4, flops_per_s=1e9)
+    b = make_bucket("lu", 64, 1, np.float32)
+    cold = ctrl.estimate_batch_s(b)
+    assert cold == pytest.approx(b.solve_flops() * 4 / 1e9)
+    ctrl.observe_batch(b, 0.5)
+    assert ctrl.estimate_batch_s(b) == pytest.approx(0.5)
+    ctrl.observe_batch(b, 1.0)
+    est = ctrl.estimate_batch_s(b)
+    assert 0.5 < est < 1.0                   # EWMA, not last-write
+    # wait estimate counts whole batches (the request rides the last one)
+    assert ctrl.estimated_wait_s(b, 0) == pytest.approx(est)
+    assert ctrl.estimated_wait_s(b, 4) == pytest.approx(2 * est)
+
+
+def test_reject_doc_schema_pin():
+    doc = adm.reject_doc("queue_pressure", queue_depth=2, estimate_s=1.5)
+    assert set(doc) == {"schema", "reason", "bucket", "queue_depth",
+                        "estimate_s", "deadline", "detail"}
+    with pytest.raises(ValueError):
+        adm.reject_doc("bogus_reason")

@@ -1,0 +1,113 @@
+"""bench_serve.py schema + bench_diff gating of the serve metrics
+(ISSUE 9): the p50/p99/solves-per-sec keys exist, and bench_diff treats
+the latency percentiles as lower-is-better."""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir)
+
+
+def _load(name, *rel):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ROOT, *rel))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def bench_serve():
+    return _load("bench_serve_mod", "bench_serve.py")
+
+
+@pytest.fixture(scope="module")
+def bd():
+    return _load("bench_diff_mod", "tools", "bench_diff.py")
+
+
+def test_run_bench_schema(grid24, bench_serve):
+    doc = bench_serve.run_bench(
+        requests=6, n=16, grid_spec=f"{grid24.height}x{grid24.width}",
+        seed=0)
+    assert doc["schema"] == bench_serve.BENCH_SERVE_SCHEMA
+    for key in ("serve_p50_ms", "serve_p99_ms", "serve_solves_per_sec"):
+        assert isinstance(doc[key], float) and doc[key] > 0
+    assert doc["serve_p50_ms"] <= doc["serve_p99_ms"]
+    assert doc["ok"] == doc["requests"] == 6
+    # warmup compiled every geometry: the measured window compiles nothing
+    assert doc["exec_compiles"] == 0
+    assert doc["exec_hits"] >= doc["batches"] >= 1
+
+
+def _doc(tmp_path, path, **kv):
+    p = tmp_path / path
+    p.write_text(json.dumps(kv))
+    return str(p)
+
+
+def test_bench_diff_gates_serve_metrics(tmp_path, bd):
+    """serve_p99_ms regresses UPWARD (lower-is-better); solves/sec
+    regresses downward; both gated by default."""
+    assert "serve_p99_ms" in bd.DEFAULT_METRICS
+    assert "serve_solves_per_sec" in bd.DEFAULT_METRICS
+    assert "serve_p99_ms" in bd.LOWER_IS_BETTER
+    base = _doc(tmp_path, "BENCH_r01.json", serve_p99_ms=10.0,
+                serve_solves_per_sec=100.0)
+    # p99 doubled + throughput halved: both regress
+    cur = _doc(tmp_path, "cur.json", serve_p99_ms=20.0,
+               serve_solves_per_sec=50.0)
+    rows = bd.compare(bd.load_doc(cur), [(base, bd.load_doc(base))],
+                      ["serve_p99_ms", "serve_solves_per_sec"],
+                      {None: 0.25})
+    verdicts = {name: regressed for name, _, _, _, _, regressed in rows}
+    assert verdicts == {"serve_p99_ms": True, "serve_solves_per_sec": True}
+    # p99 IMPROVED (halved) + throughput doubled: clean
+    cur2 = _doc(tmp_path, "cur2.json", serve_p99_ms=5.0,
+                serve_solves_per_sec=200.0)
+    rows2 = bd.compare(bd.load_doc(cur2), [(base, bd.load_doc(base))],
+                       ["serve_p99_ms", "serve_solves_per_sec"],
+                       {None: 0.25})
+    assert all(not r[-1] for r in rows2)
+    # within threshold: clean
+    cur3 = _doc(tmp_path, "cur3.json", serve_p99_ms=12.0,
+                serve_solves_per_sec=90.0)
+    rows3 = bd.compare(bd.load_doc(cur3), [(base, bd.load_doc(base))],
+                       ["serve_p99_ms", "serve_solves_per_sec"],
+                       {None: 0.25})
+    assert all(not r[-1] for r in rows3)
+
+
+def test_bench_diff_best_baseline_inverts_for_latency(tmp_path, bd):
+    """best = MIN across baselines for lower-is-better metrics."""
+    b1 = _doc(tmp_path, "BENCH_r01.json", serve_p99_ms=30.0)
+    b2 = _doc(tmp_path, "BENCH_r02.json", serve_p99_ms=10.0)
+    cur = _doc(tmp_path, "cur.json", serve_p99_ms=14.0)
+    rows = bd.compare(bd.load_doc(cur),
+                      [(b1, bd.load_doc(b1)), (b2, bd.load_doc(b2))],
+                      ["serve_p99_ms"], {None: 0.25})
+    name, curv, best, src, thr, regressed = rows[0]
+    assert best == 10.0 and os.path.basename(src) == "BENCH_r02.json"
+    assert regressed is True                     # 14 > 1.25 * 10
+    # and a tflops-style metric still gates downward on the same docs
+    b3 = _doc(tmp_path, "BENCH_r03.json", vs_baseline=0.7)
+    cur4 = _doc(tmp_path, "cur4.json", vs_baseline=0.6)
+    rows4 = bd.compare(bd.load_doc(cur4), [(b3, bd.load_doc(b3))],
+                       ["vs_baseline"], {None: 0.10})
+    assert rows4[0][-1] is True
+
+
+@pytest.mark.slow
+def test_bench_serve_cli_smoke():
+    """The subprocess path check.sh runs (slow-marked: own jax boot)."""
+    out = subprocess.run(
+        [sys.executable, "bench_serve.py", "--smoke"],
+        cwd=_ROOT, capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    assert doc["schema"] == "bench_serve/v1"

@@ -1,0 +1,150 @@
+"""Chaos under batching (ISSUE 9): the serve acceptance matrix
+{bitflip, scale, nan} x {redistribute, compute} x {oneshot, persistent},
+fault isolation of batch-mates, and deterministic replay of both fault
+logs and breaker transitions."""
+import numpy as np
+import pytest
+
+from elemental_tpu.resilience import (FaultPlan, FaultSpec,
+                                      fault_injection, logs_identical)
+from elemental_tpu.serve import SolverService, chaos_matrix, run_cell
+from elemental_tpu.serve.chaos import replay_identical
+
+from .conftest import diag_dom
+
+#: trimmed-cost service knobs for the tier-1 matrix (no retry loop --
+#: escalation's own ladder is the repair path being pinned)
+_CELL_KW = {"retries": 0}
+
+
+# ---------------------------------------------------------------------
+# THE ACCEPTANCE MATRIX -- every cell: fault fired, zero silent garbage,
+# zero collateral damage, every failure structured.
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("kind", ["bitflip", "scale", "nan"])
+@pytest.mark.parametrize("target", ["redistribute", "compute"])
+@pytest.mark.parametrize("mode", ["oneshot", "persistent"])
+def test_acceptance_matrix_cell(grid24, target, kind, mode):
+    cell, plan, svc = run_cell(
+        grid24, kind=kind, target=target, mode=mode,
+        call=2 if target == "redistribute" else 0,
+        service_kw=_CELL_KW)
+    assert cell["fired"] > 0, "fault never landed: the cell is vacuous"
+    assert cell["violations"] == []
+    assert cell["verdict"] in ("absorbed", "isolated", "surfaced")
+    # independent re-verification of every ok result (belt + braces on
+    # top of the classifier's own check)
+    from elemental_tpu.serve.executor import residual as _residual
+    from elemental_tpu.serve.chaos import build_workload
+    workload = build_workload(cell["op"], 16, 2, cell["requests"], 13)
+    for rid, (A, B) in enumerate(workload):
+        doc = svc.results[rid]
+        if doc["status"] == "ok":
+            assert _residual(A, B, svc.solutions[rid]) <= doc["tol"]
+        else:
+            assert doc["status"] in ("failed", "timed_out")
+            assert doc["certificate"] is not None or doc["timed_out"]
+
+
+def test_persistent_redist_nan_surfaced_for_all(grid24):
+    """every=True NaN on the engine can never certify anything on the
+    distributed path: every request fails STRUCTURED, with the
+    certificate naming a failing phase."""
+    cell, plan, svc = run_cell(grid24, kind="nan", target="redistribute",
+                               mode="persistent", call=2,
+                               service_kw=_CELL_KW)
+    assert cell["verdict"] == "surfaced" and cell["ok"] == 0
+    for doc in svc.results.values():
+        assert doc["status"] == "failed"
+        cert = doc["certificate"]
+        assert cert["certified"] is False
+        assert cert["failing_phase"] is not None
+
+
+def test_oneshot_compute_isolates_batch_mates(grid24):
+    """A one-shot corruption of the FIRST batched dispatch: batch-mates
+    whose slots the fault never touched all certify ok, and the touched
+    requests are absorbed by bisect re-execution (the fault does not
+    re-fire) -- zero collateral damage under batching."""
+    from elemental_tpu.serve.chaos import compute_slots
+    cell, plan, svc = run_cell(grid24, kind="nan", target="compute",
+                               mode="oneshot", nelem=4,
+                               service_kw=_CELL_KW)
+    assert cell["fired"] >= 1
+    hit = compute_slots(plan, 16, 2)
+    assert hit, "corruption landed nowhere?"
+    assert cell["violations"] == []
+    # untouched slots ended ok
+    for slot in range(cell["requests"]):
+        if slot not in hit:
+            assert svc.results[slot]["status"] == "ok"
+    # touched slots were absorbed by fresh re-execution, not escalation
+    for slot in hit:
+        doc = svc.results[slot]
+        assert doc["status"] == "ok"
+        assert doc["path"] == "fastpath"
+
+
+def test_full_matrix_report_clean(grid24):
+    """The aggregated chaos_report/v1 the CLI gate emits: all 12 cells,
+    zero violations, zero vacuous cells."""
+    report = chaos_matrix(grid24, seed=13, service_kw=_CELL_KW)
+    assert report["schema"] == "chaos_report/v1"
+    assert len(report["cells"]) == 12
+    assert report["ok"] is True
+    assert report["violations_total"] == 0
+    assert report["vacuous_cells"] == 0
+
+
+# ---------------------------------------------------------------------
+# determinism under replay
+# ---------------------------------------------------------------------
+
+def test_chaos_replay_bit_identical(grid24):
+    assert replay_identical(grid24, kind="bitflip", target="compute",
+                            mode="persistent", service_kw=_CELL_KW)
+    assert replay_identical(grid24, kind="scale", target="redistribute",
+                            mode="oneshot", service_kw=_CELL_KW)
+
+
+def test_breaker_transitions_deterministic_under_replay(grid24, fake_clock):
+    """The SAME persistent fault plan replayed over the SAME submission
+    schedule produces the SAME breaker transition sequence (trip ->
+    half-open -> re-open), pinned via the per-request breaker snapshots
+    and the transition counters."""
+    from elemental_tpu.obs import metrics as _metrics
+    rng0 = np.random.default_rng(31)
+    probs = [(diag_dom(rng0, 16), rng0.normal(size=(16, 2)))
+             for _ in range(6)]
+
+    def run():
+        clk = type(fake_clock)()
+        svc = SolverService(grid24, clock=clk, sleep=clk.sleep,
+                            breaker_threshold=2, breaker_cooldown_s=5.0,
+                            retries=0, max_batch=1)
+        plan = FaultPlan(seed=3, faults=[
+            FaultSpec("compute", "nan", call=0, every=True, nelem=40)])
+        trail = []
+        with _metrics.scoped() as reg:
+            with fault_injection(plan):
+                for i, (A, B) in enumerate(probs):
+                    rid = svc.submit("lu", A, B)
+                    if isinstance(rid, dict):
+                        trail.append(("reject", rid["reason"]))
+                        clk.advance(6.0)     # wait out the cooldown
+                        continue
+                    svc.drain()
+                    trail.append((svc.results[rid]["status"],
+                                  svc.results[rid]["breaker"]))
+            trans = sorted((dict(lb)["to"], v) for (nm, lb), v in
+                           reg.counters("serve_breaker_transitions").items())
+        return trail, trans, plan
+
+    t1, tr1, p1 = run()
+    t2, tr2, p2 = run()
+    assert t1 == t2
+    assert tr1 == tr2
+    assert logs_identical(p1, p2)
+    assert ("reject", "breaker_open") in t1   # the breaker actually tripped
+    assert any(to == "half_open" for to, _ in tr1)

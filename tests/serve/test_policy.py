@@ -1,0 +1,116 @@
+"""Resilience policy (ISSUE 9): deterministic backoff, breaker state
+machine (trip / half-open probe / close) with structured metrics, the
+load-aware degradation ladder."""
+import pytest
+
+from elemental_tpu.obs import metrics as _metrics
+from elemental_tpu.serve import (CLOSED, HALF_OPEN, OPEN, CircuitBreaker,
+                                 Deadline, RetryPolicy, select_ladder)
+from elemental_tpu.resilience import LADDER_NAMES
+
+
+# ---------------------------------------------------------------------
+# backoff
+# ---------------------------------------------------------------------
+
+def test_backoff_deterministic_and_exponential():
+    p = RetryPolicy(retries=3, base_s=0.1, jitter=0.5, seed=42)
+    d1 = p.delay_s(7, 1)
+    assert d1 == p.delay_s(7, 1)                 # same stream, same delay
+    assert p.delay_s(7, 1) != p.delay_s(8, 1)    # per-request stream
+    assert p.delay_s(7, 1) != RetryPolicy(retries=3, base_s=0.1,
+                                          jitter=0.5, seed=43).delay_s(7, 1)
+    # base * 2^(k-1) <= delay <= base * 2^(k-1) * (1 + jitter)
+    for k in (1, 2, 3):
+        d = p.delay_s(7, k)
+        lo = 0.1 * 2 ** (k - 1)
+        assert lo <= d <= lo * 1.5
+    assert p.delay_s(7, 2) > p.delay_s(7, 1)
+
+
+def test_backoff_deadline_clamped(fake_clock):
+    p = RetryPolicy(retries=2, base_s=10.0, jitter=0.0, seed=0)
+    dl = Deadline(12.0, clock=fake_clock)
+    # clamped so the retry itself still has ~base_s of budget
+    assert p.delay_s(0, 1, dl) == pytest.approx(2.0)
+    fake_clock.advance(13.0)
+    assert p.delay_s(0, 1, dl) < 0               # expired: no retry
+
+
+# ---------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------
+
+def test_breaker_trip_halfopen_close_cycle(fake_clock):
+    with _metrics.scoped() as reg:
+        br = CircuitBreaker("lu__b16x2__float64", threshold=3,
+                            cooldown_s=5.0, clock=fake_clock)
+        assert br.state == CLOSED and br.allow()
+        br.record_failure()
+        br.record_failure()
+        assert br.state == CLOSED                # below threshold
+        br.record_failure()                      # 3rd consecutive: trip
+        assert br.state == OPEN
+        assert not br.allow()
+        fake_clock.advance(4.9)
+        assert not br.allow()                    # cooldown not elapsed
+        fake_clock.advance(0.2)
+        assert br.allow()                        # -> half-open, ONE probe
+        assert br.state == HALF_OPEN
+        assert not br.allow()                    # probe already in flight
+        br.record_success()                      # probe passed
+        assert br.state == CLOSED and br.allow()
+
+        # trip again, fail the probe: straight back to open
+        for _ in range(3):
+            br.record_failure()
+        assert br.state == OPEN
+        fake_clock.advance(5.1)
+        assert br.allow() and br.state == HALF_OPEN
+        br.record_failure()
+        assert br.state == OPEN and not br.allow()
+
+        # metrics: gauge encodes the state, transitions counted
+        gauges = [r["value"] for r in reg.to_doc()["gauges"]
+                  if r["name"] == "serve_breaker_state"
+                  and r["labels"] == {"bucket": "lu__b16x2__float64"}]
+        assert gauges == [1]                     # open
+        trans = {dict(lb)["to"]: v for (nm, lb), v in
+                 reg.counters("serve_breaker_transitions").items()}
+        assert trans == {"open": 3, "half_open": 2, "closed": 1}
+
+
+def test_breaker_success_resets_consecutive_count(fake_clock):
+    br = CircuitBreaker("b", threshold=3, clock=fake_clock)
+    br.record_failure()
+    br.record_failure()
+    br.record_success()                          # streak broken
+    br.record_failure()
+    br.record_failure()
+    assert br.state == CLOSED                    # 2 consecutive, not 3
+    br.record_failure()
+    assert br.state == OPEN
+    doc = br.to_doc()
+    assert doc["state"] == "open" and doc["threshold"] == 3
+
+
+# ---------------------------------------------------------------------
+# degradation ladder
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("op", ["lu", "hpd"])
+def test_select_ladder_pressure_gates_quant(op):
+    """Under pressure the full ladder runs quant-first (the EQuARX
+    cheap-but-narrow trade); unloaded it starts at the full-wire fast
+    rung."""
+    hot = select_ladder(op, pressure=0.9)
+    assert tuple(r.name for r in hot) == LADDER_NAMES
+    cold = select_ladder(op, pressure=0.1)
+    assert tuple(r.name for r in cold) == tuple(
+        n for n in LADDER_NAMES if n != "quant")
+    assert cold[0].name == "fast"
+    # the boundary is inclusive-hot
+    assert tuple(r.name for r in select_ladder(op, 0.5)) == LADDER_NAMES
+    # custom threshold
+    assert len(select_ladder(op, 0.2, degrade_pressure=0.1)) == \
+        len(LADDER_NAMES)

@@ -1,0 +1,193 @@
+"""SolverService end-to-end (ISSUE 9): batched fastpath certification,
+result schema, deadline drops, shedding, breaker integration, structured
+failure for unsolvable requests."""
+import numpy as np
+import pytest
+
+from elemental_tpu.obs import metrics as _metrics
+from elemental_tpu.serve import RESULT_SCHEMA, SolverService
+
+from .conftest import diag_dom, spd
+
+
+def _mixed_workload(rng, count=6):
+    out = []
+    for i in range(count):
+        n = (12, 16, 9)[i % 3]
+        if i % 2:
+            out.append(("lu", diag_dom(rng, n), rng.normal(size=(n, 2))))
+        else:
+            out.append(("hpd", spd(rng, n), rng.normal(size=(n, 2))))
+    return out
+
+
+def test_fastpath_serving_end_to_end(grid24):
+    rng = np.random.default_rng(20)
+    svc = SolverService(grid24)
+    work = _mixed_workload(rng)
+    ids = [svc.submit(op, A, B) for op, A, B in work]
+    assert all(isinstance(i, int) for i in ids)
+    done = svc.drain()
+    assert set(done) == set(ids)
+    for (op, A, B), rid in zip(work, ids):
+        doc = done[rid]
+        assert doc["status"] == "ok" and doc["path"] == "fastpath"
+        assert doc["rung"] == "fastpath"
+        assert doc["residual"] <= doc["tol"]
+        X = svc.solutions[rid]
+        np.testing.assert_allclose(X, np.linalg.solve(A, B),
+                                   rtol=1e-8, atol=1e-10)
+        assert doc["latency_s"] >= 0.0
+    assert svc.queue_depth() == 0
+
+
+def test_result_schema_pin(grid24):
+    rng = np.random.default_rng(21)
+    svc = SolverService(grid24)
+    X, doc = svc.solve("lu", diag_dom(rng, 8), rng.normal(size=(8, 1)))
+    assert doc["schema"] == RESULT_SCHEMA
+    assert set(doc) == {"schema", "id", "op", "n", "nrhs", "bucket",
+                        "status", "path", "rung", "residual", "tol",
+                        "retries", "bisected", "timed_out", "latency_s",
+                        "deadline", "certificate", "breaker"}
+    assert doc["bucket"] == "lu__b8x1__float64"
+    assert doc["deadline"] is None and doc["certificate"] is None
+    assert doc["breaker"] == "closed"
+    assert X is not None
+
+
+def test_expired_deadline_dropped_before_launch(grid24, fake_clock):
+    """A request whose deadline lapses in the queue is finalized as a
+    structured timed_out WITHOUT paying for a dispatch."""
+    rng = np.random.default_rng(22)
+    svc = SolverService(grid24, clock=fake_clock, sleep=fake_clock.sleep)
+    ok_id = svc.submit("lu", diag_dom(rng, 8), rng.normal(size=(8, 1)))
+    dead_id = svc.submit("lu", diag_dom(rng, 8), rng.normal(size=(8, 1)),
+                         budget_s=1.0)
+    fake_clock.advance(2.0)
+    done = svc.drain()
+    assert done[dead_id]["status"] == "timed_out"
+    assert done[dead_id]["path"] == "dropped"
+    assert done[dead_id]["timed_out"] is True
+    assert done[dead_id]["deadline"]["remaining_s"] < 0
+    assert dead_id not in svc.solutions
+    assert done[ok_id]["status"] == "ok"        # no collateral
+
+
+def test_submit_sheds_under_queue_pressure(grid24, fake_clock):
+    """With a hopeless throughput estimate, deadline'd submissions shed
+    fast once the bucket queue is deep; the structured reject counts."""
+    rng = np.random.default_rng(23)
+    svc = SolverService(grid24, clock=fake_clock, sleep=fake_clock.sleep,
+                        flops_per_s=1.0, max_batch=2)
+    A, B = diag_dom(rng, 8), rng.normal(size=(8, 1))
+    with _metrics.scoped() as reg:
+        assert isinstance(svc.submit("lu", A, B), int)   # no deadline
+        rej = svc.submit("lu", A, B, budget_s=5.0)
+        assert isinstance(rej, dict)
+        assert rej["reason"] == "queue_pressure"
+        assert reg.counter_value("serve_rejects",
+                                 reason="queue_pressure") == 1
+
+
+def test_unsolvable_request_fails_structured(grid24):
+    """A singular system can never certify: fastpath fails, bisect
+    isolates it, escalation exhausts the ladder, and the result is a
+    structured failure WITH the certificate -- while a batch-mate in the
+    same bucket still certifies (fault isolation without faults)."""
+    rng = np.random.default_rng(24)
+    n = 12
+    Asing = np.ones((n, n))                      # rank 1
+    B = rng.normal(size=(n, 1))
+    svc = SolverService(grid24, retries=0)
+    good_id = svc.submit("lu", diag_dom(rng, n), B)
+    bad_id = svc.submit("lu", Asing, B)
+    done = svc.drain()
+    assert done[good_id]["status"] == "ok"
+    bad = done[bad_id]
+    assert bad["status"] == "failed"
+    assert bad["path"] == "escalated" and bad["bisected"] is True
+    cert = bad["certificate"]
+    assert cert is not None and cert["certified"] is False
+    assert cert["singular"] is True
+    assert bad_id not in svc.solutions           # zero silent garbage
+
+
+def test_breaker_trips_rejects_then_recovers(grid24, fake_clock):
+    """Consecutive fastpath certification failures trip the bucket's
+    breaker: new submissions reject fast; after the cooldown a probe
+    batch closes it again.  Deterministic under the fake clock."""
+    rng = np.random.default_rng(25)
+    n = 8
+    Asing = np.ones((n, n))
+    B = rng.normal(size=(n, 1))
+    svc = SolverService(grid24, clock=fake_clock, sleep=fake_clock.sleep,
+                        breaker_threshold=2, breaker_cooldown_s=10.0,
+                        retries=0, max_batch=1)
+    # two failing batches (max_batch=1 => one request per batch)
+    for _ in range(2):
+        rid = svc.submit("lu", Asing, B)
+        assert isinstance(rid, int)
+        svc.drain()
+    key = "lu__b8x1__float64"
+    assert svc.breakers[key].state == "open"
+    rej = svc.submit("lu", diag_dom(rng, n), B)
+    assert isinstance(rej, dict) and rej["reason"] == "breaker_open"
+    # queued work admitted after cooldown runs as the half-open probe
+    fake_clock.advance(11.0)
+    rid = svc.submit("lu", diag_dom(rng, n), B)
+    assert isinstance(rid, int)
+    done = svc.drain()
+    assert done[rid]["status"] == "ok"
+    assert svc.breakers[key].state == "closed"   # probe success closed it
+
+
+def test_open_breaker_routes_queued_to_escalation(grid24, fake_clock):
+    """Requests already queued when the breaker opens are NOT dropped:
+    they bypass the poisoned fastpath straight to the certified path."""
+    rng = np.random.default_rng(26)
+    n = 8
+    Asing = np.ones((n, n))
+    B = rng.normal(size=(n, 1))
+    svc = SolverService(grid24, clock=fake_clock, sleep=fake_clock.sleep,
+                        breaker_threshold=1, breaker_cooldown_s=1e9,
+                        retries=0, max_batch=1)
+    bad = svc.submit("lu", Asing, B)
+    good = svc.submit("lu", diag_dom(rng, n), B)  # queued before the trip
+    done = svc.drain()
+    assert done[bad]["status"] == "failed"
+    gd = done[good]
+    assert gd["status"] == "ok"
+    assert gd["path"] == "escalated"             # fastpath was bypassed
+    assert gd["rung"] in ("quant", "fast", "refine", "fp32", "classic")
+
+
+def test_pressure_and_gauges(grid24):
+    rng = np.random.default_rng(27)
+    svc = SolverService(grid24, capacity=4)
+    with _metrics.scoped() as reg:
+        for _ in range(3):
+            svc.submit("lu", diag_dom(rng, 8), rng.normal(size=(8, 1)))
+        assert svc.pressure() == pytest.approx(3 / 4)
+        gauges = {r["name"]: r["value"] for r in reg.to_doc()["gauges"]}
+        assert gauges["serve_queue_depth"] == 3
+        assert gauges["serve_pressure"] == pytest.approx(0.75)
+        svc.drain()
+        gauges = {r["name"]: r["value"] for r in reg.to_doc()["gauges"]}
+        assert gauges["serve_queue_depth"] == 0
+        assert reg.counter_value("serve_requests", op="lu",
+                                 status="ok") == 3
+
+
+def test_fifo_across_buckets(grid24, fake_clock):
+    """drain picks the bucket holding the OLDEST queued request first."""
+    rng = np.random.default_rng(28)
+    svc = SolverService(grid24, clock=fake_clock, sleep=fake_clock.sleep)
+    a = svc.submit("lu", diag_dom(rng, 8), rng.normal(size=(8, 1)))
+    fake_clock.advance(1.0)
+    b = svc.submit("hpd", spd(rng, 8), rng.normal(size=(8, 1)))
+    fake_clock.advance(1.0)
+    done = svc.drain()
+    # the lu request waited longer than the hpd one
+    assert done[a]["latency_s"] > done[b]["latency_s"]
+    assert done[a]["status"] == done[b]["status"] == "ok"

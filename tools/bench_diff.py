@@ -29,7 +29,12 @@ forms may repeat; built-in per-metric defaults live in
     current < (1 - threshold) * max(baselines)
 
 i.e. the gate compares against the BEST recorded value, so a slow decay
-across rounds cannot ratchet the bar down.  Nested documents under the
+across rounds cannot ratchet the bar down.  Latency-style metrics listed
+in :data:`LOWER_IS_BETTER` (the ``bench_serve.py`` percentiles, ISSUE 9)
+invert: best is the MINIMUM baseline and a regression is
+``current > (1 + threshold) * best`` -- so ``serve_p99_ms`` and
+``serve_solves_per_sec`` gate serving latency/throughput alongside the
+TFLOP/s headlines.  Nested documents under the
 ``"obs"`` key (the ``obs_bench/v1`` trail, including ISSUE 8's
 ``redist_wire_bytes`` total) are accepted and surfaced as informational
 lines, never gated -- byte estimates are schedule properties, not
@@ -52,14 +57,23 @@ import re
 import sys
 
 DEFAULT_METRICS = ("vs_baseline", "lu_vs_baseline",
-                   "lu_n32768_tflops_per_chip")
+                   "lu_n32768_tflops_per_chip",
+                   "serve_p99_ms", "serve_solves_per_sec")
 DEFAULT_THRESHOLD = 0.10
 
 #: built-in per-metric thresholds (user ``--threshold NAME=X`` overrides).
 #: Raw TFLOP/s metrics on shared/tunneled chips swing with chip weather
 #: (see bench.py), so the named LU headline gets a wider band than the
-#: roofline-normalized default ratios.
-DEFAULT_PER_METRIC = {"lu_n32768_tflops_per_chip": 0.25}
+#: roofline-normalized default ratios; serving wall-clock metrics swing
+#: with host weather and get the same wide band.
+DEFAULT_PER_METRIC = {"lu_n32768_tflops_per_chip": 0.25,
+                      "serve_p99_ms": 0.25,
+                      "serve_solves_per_sec": 0.25}
+
+#: metrics where SMALLER is better (latency percentiles from
+#: bench_serve.py): the gate inverts -- best baseline is the MINIMUM and
+#: a regression is ``current > (1 + threshold) * best``.
+LOWER_IS_BETTER = {"serve_p50_ms", "serve_p99_ms"}
 
 _ROUND_RE = re.compile(r"_r(\d+)\.json$")
 
@@ -111,15 +125,18 @@ def compare(current: dict, baselines: list, metrics, thresholds) -> list:
         cur = current.get(name)
         if not isinstance(cur, (int, float)):
             continue
+        lower = name in LOWER_IS_BETTER
         best, src = None, None
         for path, doc in baselines:
             v = doc.get(name)
-            if isinstance(v, (int, float)) and (best is None or v > best):
+            if isinstance(v, (int, float)) and (
+                    best is None or (v < best if lower else v > best)):
                 best, src = v, path
         if best is None:
             continue
         thr = thresholds.get(name, thresholds.get(None, DEFAULT_THRESHOLD))
-        regressed = cur < (1.0 - thr) * best
+        regressed = cur > (1.0 + thr) * best if lower \
+            else cur < (1.0 - thr) * best
         rows.append((name, cur, best, src, thr, regressed))
     return rows
 

@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# One-shot pre-commit gate (ISSUE 3 + 4 + 5 + 6 + 7): style lint +
+# One-shot pre-commit gate (ISSUE 3 + 4 + 5 + 6 + 7 + 9): style lint +
 # comm-plan lint + golden comm-plan diff + autotuner cost-model
 # self-check + the tier-1 tests/tune subset + the calu/tsqr lapack gate
 # (comm lint/diff on the lu/qr variants, golden-coverage check, lu/qr
@@ -26,6 +26,12 @@
 #                             #   golden-coverage check, lapack lu/qr tests
 #   tools/check.sh resilience # certified-solve smoke (1x1 + 2x2, CPU-safe)
 #                             #   + tests/resilience fault/health suite
+#   tools/check.sh serve      # solver-service gate (ISSUE 9): serve smoke
+#                             #   on 1x1 + 2x2, the chaos acceptance
+#                             #   matrix ({bitflip,scale,nan} x
+#                             #   {redistribute,compute} x {oneshot,
+#                             #   persistent}), the bench_serve schema
+#                             #   smoke, and tests/serve
 set -u
 cd "$(dirname "$0")/.."
 
@@ -125,6 +131,17 @@ if [ "$what" = "all" ] || [ "$what" = "resilience" ]; then
     JAX_PLATFORMS=cpu python -m perf.certify smoke || rc=1
     echo "== resilience tier-1 tests (fault injection + health + certify) =="
     python -m pytest tests/resilience -q -m 'not slow' -p no:cacheprovider || rc=1
+fi
+
+if [ "$what" = "all" ] || [ "$what" = "serve" ]; then
+    echo "== solver-service smoke (1x1 + 2x2, exec-cache reuse, CPU-safe) =="
+    JAX_PLATFORMS=cpu python -m perf.serve smoke || rc=1
+    echo "== chaos acceptance matrix (faults x targets x modes, 2x2) =="
+    JAX_PLATFORMS=cpu python -m perf.serve chaos || rc=1
+    echo "== bench_serve schema smoke (p50/p99 + solves/sec present) =="
+    JAX_PLATFORMS=cpu python bench_serve.py --smoke > /dev/null || rc=1
+    echo "== serve tier-1 tests (admission/executor/policy/service/chaos) =="
+    python -m pytest tests/serve -q -m 'not slow' -p no:cacheprovider || rc=1
 fi
 
 if [ "$rc" -eq 0 ]; then
