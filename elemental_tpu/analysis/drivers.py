@@ -105,16 +105,18 @@ def _herk_spec():
     return DriverSpec("herk", build)
 
 
-def _cholesky_spec(variant, lookahead, crossover, comm_precision=None):
+def _cholesky_spec(variant, lookahead, crossover, comm_precision=None,
+                   abft=False):
     def build(grid, n, nb, dtype):
         from ..lapack.cholesky import cholesky
 
         def fn(a):
             return cholesky(_as_dm(a, grid, n, n), nb=nb,
                             lookahead=lookahead, crossover=crossover,
-                            comm_precision=comm_precision)
+                            comm_precision=comm_precision,
+                            abft=abft or None)
         meta = {"lookahead": lookahead, "crossover": crossover,
-                "comm_precision": comm_precision}
+                "comm_precision": comm_precision, "abft": abft}
         return fn, (_mcmr_input(grid, n, n, dtype),), meta
     # commq variants intentionally move bf16 on the wire (EL005 opt-in)
     return DriverSpec(f"cholesky_{variant}", build,
@@ -122,16 +124,17 @@ def _cholesky_spec(variant, lookahead, crossover, comm_precision=None):
 
 
 def _lu_spec(variant, lookahead, crossover, panel="classic",
-             comm_precision=None):
+             comm_precision=None, abft=False):
     def build(grid, n, nb, dtype):
         from ..lapack.lu import lu
 
         def fn(a):
             return lu(_as_dm(a, grid, n, n), nb=nb,
                       lookahead=lookahead, crossover=crossover, panel=panel,
-                      comm_precision=comm_precision)
+                      comm_precision=comm_precision, abft=abft or None)
         meta = {"lookahead": lookahead, "crossover": crossover,
-                "panel": panel, "comm_precision": comm_precision}
+                "panel": panel, "comm_precision": comm_precision,
+                "abft": abft}
         return fn, (_mcmr_input(grid, n, n, dtype),), meta
     return DriverSpec(f"lu_{variant}", build,
                       allow_bf16=comm_precision is not None)
@@ -178,6 +181,13 @@ def _registry() -> dict:
                  panel="calu", comm_precision="bf16"),
         _cholesky_spec("lookahead_commq", lookahead=True, crossover=0,
                        comm_precision="bf16"),
+        # abft = ISSUE 11's checksum-guarded drivers: the classic
+        # right-looking schedule (abft= forces it) plus the per-panel
+        # checksum maintenance, traced with the guard's host checks
+        # inert -- the golden pins the ABFT-enabled collective structure
+        # so checksum overhead changes are a reviewed diff
+        _lu_spec("abft", lookahead=False, crossover=0, abft=True),
+        _cholesky_spec("abft", lookahead=False, crossover=0, abft=True),
     ]
     return {s.name: s for s in specs}
 

@@ -248,7 +248,7 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
              precision=None, lookahead: bool | str = True,
              crossover: int | str | None = None,
              comm_precision: str | None = None, timer=None,
-             health=None) -> DistMatrix:
+             health=None, abft=None) -> DistMatrix:
     """Cholesky factor of an HPD [MC,MR] matrix; reads only the ``uplo``
     triangle.  Returns L (A = L L^H) for 'L', U (A = U^H U) for 'U'.
 
@@ -278,6 +278,14 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
     estimate, non-positive/near-zero diagonal detection on the ``diag``
     ticks): a ``HealthMonitor`` or ``True``, same semantics as
     ``lu(..., health=...)``; ``None`` (default) attaches nothing.
+
+    ``abft`` opts into checksum-guarded execution with panel-granular
+    recovery (same semantics as ``lu(..., abft=...)``; ISSUE 11): the
+    guarded path verifies column-sum invariants per panel and on
+    violation re-executes only that panel step.  It forces the classic
+    right-looking schedule (``lookahead`` / ``crossover`` ignored);
+    ``abft=None`` (default) is the unguarded path, bit-identical to
+    before.
     """
     _check_mcmr(A)
     if any(isinstance(v, str) for v in (nb, lookahead, crossover)) \
@@ -297,8 +305,13 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
         L = cholesky(Alow, "L", nb=nb, precision=precision,
                      lookahead=lookahead, crossover=crossover,
                      comm_precision=comm_precision, timer=timer,
-                     health=health)
+                     health=health, abft=abft)
         return redistribute(transpose_dist(L, conj=True), MC, MR)
+    if abft:
+        from ..resilience.abft import abft_cholesky
+        return abft_cholesky(A, nb=nb, precision=precision,
+                             comm_precision=comm_precision, timer=timer,
+                             health=health, abft=abft)
 
     m = A.gshape[0]
     if A.gshape != (m, m):

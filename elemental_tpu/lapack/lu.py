@@ -592,7 +592,8 @@ _CROSSOVER = 4096
 def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
        update_precision=None, lookahead: bool | str = True,
        crossover: int | str | None = None, panel: str = "classic",
-       comm_precision: str | None = None, timer=None, health=None):
+       comm_precision: str | None = None, timer=None, health=None,
+       abft=None):
     """Blocked right-looking LU with partial pivoting and look-ahead.
 
     Returns (LU, perm): LU holds unit-lower L below the diagonal and U on
@@ -655,7 +656,21 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
     tick hook as ``timer`` -- NaN/Inf scans, a growth-factor estimate,
     and near-zero pivot detection at every phase boundary, engine-free.
     ``health=None`` (default) attaches nothing: the zero-overhead
-    NULL_HOOK path, pinned by the redist-count goldens."""
+    NULL_HOOK path, pinned by the redist-count goldens.
+
+    ``abft`` opts into checksum-guarded execution with panel-granular
+    recovery (``elemental_tpu/resilience/abft.py``, ISSUE 11): pass
+    ``True`` (report via ``resilience.last_abft_report('lu')``) or a
+    caller-owned ``AbftGuard``.  The guarded path verifies
+    Huang-Abraham column-sum invariants after every transport / panel
+    factor / trailing update and, on violation, rolls back and
+    re-executes ONLY the corrupted panel step (bounded retries, then
+    surfaces through ``health_report/v1``).  It forces the CLASSIC
+    right-looking schedule on every grid (``lookahead`` / ``crossover``
+    / ``panel='calu'`` are ignored: pipelining and tournament pivoting
+    do not compose with per-panel transactions).  ``abft=None``
+    (default) is the unguarded zero-overhead path, bit-identical to
+    before -- pinned by the comm-plan goldens."""
     _check_mcmr(A)
     if any(isinstance(v, str) for v in (nb, lookahead, crossover)) \
             or panel == "auto" or comm_precision == "auto":
@@ -667,6 +682,12 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
         nb, lookahead, crossover = kn["nb"], kn["lookahead"], kn["crossover"]
         panel, comm_precision = kn["panel"], kn["comm_precision"]
     check_comm_precision(comm_precision)
+    if abft:
+        from ..resilience.abft import abft_lu
+        return abft_lu(A, nb=nb, precision=precision,
+                       update_precision=update_precision,
+                       comm_precision=comm_precision, timer=timer,
+                       health=health, abft=abft)
     if panel is None:
         panel = "classic"
     if panel not in ("classic", "calu"):

@@ -181,6 +181,21 @@ def fault_injection(plan):
         _FAULT_INJECTOR = prev
 
 
+def set_fault_step(step) -> None:
+    """Announce the current driver panel step to the installed fault
+    injector (``None`` = leaving the step scope).  Gates
+    ``FaultSpec(window=...)`` rules (ISSUE 11): the ABFT-guarded
+    factorizations call this at every panel-transaction boundary so
+    chaos tests can corrupt a chosen step deterministically.  A no-op --
+    zero traced operations -- when no injector is installed or the
+    injector has no ``set_step``."""
+    inj = _FAULT_INJECTOR
+    if inj is not None:
+        f = getattr(inj, "set_step", None)
+        if f is not None:
+            f(step)
+
+
 def apply_fault(target: str, outputs: tuple) -> tuple:
     """Route eager kernel outputs through the installed fault injector;
     identity (and zero-overhead) when none is installed.

@@ -9,7 +9,8 @@ makes numerical corruption DETECTED, REPORTED, and RECOVERED:
                    seam (``lu(..., health=...)``) -> ``health_report/v1``
   :mod:`.certify`  ``certified_solve``: true-residual certificate +
                    iterative refinement + the deterministic escalation
-                   ladder (quant -> fast -> refine -> fp32 -> classic),
+                   ladder (quant -> fast -> refine -> abft -> fp32 ->
+                   classic),
                    deadline-boundable via ``deadline=`` (ISSUE 9: an
                    exhausted budget returns best-so-far + ``timed_out``)
   :mod:`.faults`   seeded ``FaultPlan`` corruption of engine payloads
@@ -18,9 +19,17 @@ makes numerical corruption DETECTED, REPORTED, and RECOVERED:
                    target (ISSUE 9) -- of local panel/batch kernel
                    outputs -- the test harness proving every corruption
                    class is repaired or surfaced
+  :mod:`.abft`     checksum-guarded factorizations (ISSUE 11):
+                   ``lu(..., abft=)`` / ``cholesky(..., abft=)`` verify
+                   Huang-Abraham column-sum invariants per panel ->
+                   ``abft_report/v1``
+  :mod:`.recovery` the panel-transaction layer: a violated panel step is
+                   rolled back and re-executed (bounded retries), so a
+                   transient fault costs ONE recomputed panel instead of
+                   a full re-solve
 
-CLI: ``python -m perf.certify {run,smoke}``; gate: ``tools/check.sh
-resilience``.
+CLI: ``python -m perf.certify {run,smoke}``, ``python -m perf.abft
+smoke``; gates: ``tools/check.sh resilience``, ``tools/check.sh abft``.
 """
 from ..redist.engine import fault_injection
 from .health import (HEALTH_SCHEMA, HealthMonitor, attach_health,
@@ -29,6 +38,9 @@ from .certify import (CERT_SCHEMA, LADDER_NAMES, Rung, certified_solve,
                       default_ladder, default_tol)
 from .faults import (FAULT_KINDS, FAULT_TARGETS, FaultEvent, FaultPlan,
                      FaultSpec, logs_identical)
+from .abft import (ABFT_SCHEMA, AbftGuard, abft_cholesky, abft_lu,
+                   last_abft_report)
+from .recovery import run_step
 
 __all__ = [
     "HEALTH_SCHEMA", "HealthMonitor", "attach_health", "factor_diag_info",
@@ -37,4 +49,6 @@ __all__ = [
     "default_ladder", "default_tol",
     "FAULT_KINDS", "FAULT_TARGETS", "FaultEvent", "FaultPlan", "FaultSpec",
     "logs_identical", "fault_injection",
+    "ABFT_SCHEMA", "AbftGuard", "abft_cholesky", "abft_lu",
+    "last_abft_report", "run_step",
 ]

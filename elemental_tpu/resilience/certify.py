@@ -85,8 +85,12 @@ CERT_SCHEMA = "solve_certificate/v1"
 #: documented default certification tolerance: ``TOL_FACTOR * n * eps``
 TOL_FACTOR = 64.0
 
-#: canonical ladder rung names, in escalation order (pinned by tests)
-LADDER_NAMES = ("quant", "fast", "refine", "fp32", "classic")
+#: canonical ladder rung names, in escalation order (pinned by tests).
+#: 'abft' (ISSUE 11) sits between the cheap re-refine rung and the full
+#: fp32 refactorization: a TRANSIENT fault is repaired by re-executing
+#: one panel (checksum-guarded classic schedule) before the ladder pays
+#: for whole-solve escalation.
+LADDER_NAMES = ("quant", "fast", "refine", "abft", "fp32", "classic")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -104,8 +108,12 @@ def default_ladder(op: str):
     ISSUE-8 wire-quantized rung ('fast' + ``comm_precision='int8'``,
     ``COMM_PRECISIONS[2]``), 'fast' rides the ISSUE-6 CALU panel
     (``LU_PANELS[1]``; degenerates to classic on single-row grids inside
-    the driver) with default-precision trailing updates, 'classic' is
-    ``LU_PANELS[0]`` / the classic schedule."""
+    the driver) with default-precision trailing updates, 'abft' (ISSUE
+    11) re-factors under the checksum-guarded classic schedule
+    (``abft=True``: a transient fault is detected and repaired at PANEL
+    granularity inside the driver, so this rung succeeds where 'refine'
+    could not without paying fp32), 'classic' is ``LU_PANELS[0]`` / the
+    classic schedule."""
     from jax import lax
     from ..tune.knobs import COMM_PRECISIONS
     q8 = COMM_PRECISIONS[2]                      # 'int8'
@@ -117,6 +125,8 @@ def default_ladder(op: str):
             Rung("quant", {**fast, "comm_precision": q8}, refine=8),
             Rung("fast", fast, refine=2),
             Rung("refine", fast, refine=8, refactor=False),
+            Rung("abft", {"abft": True, "update_precision": None},
+                 refine=4),
             Rung("fp32", {"panel": calu, "update_precision": None},
                  refine=4),
             Rung("classic", {"panel": classic, "update_precision": None},
@@ -128,6 +138,7 @@ def default_ladder(op: str):
             Rung("quant", {**fast, "comm_precision": q8}, refine=8),
             Rung("fast", fast, refine=2),
             Rung("refine", fast, refine=8, refactor=False),
+            Rung("abft", {"abft": True, "precision": None}, refine=4),
             Rung("fp32", {"precision": lax.Precision.HIGHEST}, refine=4),
             Rung("classic", {"precision": lax.Precision.HIGHEST,
                              "lookahead": False}, refine=4),

@@ -58,13 +58,26 @@ _KIND_WORD = {k: i + 1 for i, k in enumerate(FAULT_KINDS)}
 
 @dataclasses.dataclass(frozen=True)
 class FaultSpec:
-    """One corruption rule of a plan."""
+    """One corruption rule of a plan.
+
+    ``window=(start, stop)`` (ISSUE 11) scopes the rule to driver panel
+    STEPS ``start <= k < stop`` -- drivers that announce their current
+    step via ``engine.set_fault_step`` (the ABFT-guarded factorizations)
+    gate the rule on it, so chaos can deterministically corrupt a chosen
+    panel.  Windowed one-shot rules (``every=False``) fire exactly ONCE:
+    on the first matching call inside the window (``call`` then acts as
+    a minimum call index, default 0) -- so a recovery retry of the
+    corrupted panel re-executes CLEAN.  ``every=True`` windows corrupt
+    every in-window call from ``call`` onward.  Outside any
+    ``set_fault_step`` scope a windowed rule never fires; the corruption
+    stream of non-windowed rules is unchanged (replay bit-identity)."""
     target: str                  # "redistribute" | "panel_spread"
     kind: str                    # "bitflip" | "scale" | "nan"
     call: int = 0                # nth public entry of ``target`` (0-based)
     every: bool = False          # corrupt every call index >= ``call``
     nelem: int = 1               # elements corrupted per payload array
     factor: float = 1e12         # 'scale' multiplier
+    window: tuple | None = None  # (start, stop) panel-step scope
 
     def __post_init__(self):
         if self.target not in FAULT_TARGETS:
@@ -75,10 +88,23 @@ class FaultSpec:
                              f"expected one of {FAULT_KINDS}")
         if self.call < 0 or self.nelem < 1:
             raise ValueError("FaultSpec needs call >= 0 and nelem >= 1")
+        if self.window is not None:
+            w = tuple(self.window)
+            if len(w) != 2 or int(w[0]) < 0 or int(w[1]) <= int(w[0]):
+                raise ValueError("FaultSpec window needs (start, stop) "
+                                 "with 0 <= start < stop")
+            object.__setattr__(self, "window", (int(w[0]), int(w[1])))
 
-    def matches(self, target: str, call: int) -> bool:
-        return self.target == target and \
-            (call >= self.call if self.every else call == self.call)
+    def matches(self, target: str, call: int,
+                step: int | None = None) -> bool:
+        if self.target != target:
+            return False
+        if self.window is not None:
+            if step is None or not (self.window[0] <= step
+                                    < self.window[1]):
+                return False
+            return call >= self.call  # one-shot gating lives in the plan
+        return call >= self.call if self.every else call == self.call
 
 
 @dataclasses.dataclass
@@ -93,6 +119,7 @@ class FaultEvent:
     indices: np.ndarray          # flat element indices corrupted
     before: np.ndarray
     after: np.ndarray
+    step: int | None = None      # announced panel step, if any (ISSUE 11)
 
 
 class FaultPlan:
@@ -112,11 +139,21 @@ class FaultPlan:
                                 f"{type(f).__name__}")
         self.calls: dict = {t: 0 for t in FAULT_TARGETS}
         self.log: list[FaultEvent] = []
+        self.step: int | None = None      # current driver panel step
+        self._window_fired: set = set()   # one-shot windowed rules spent
 
     def reset(self) -> "FaultPlan":
         self.calls = {t: 0 for t in FAULT_TARGETS}
         self.log = []
+        self.step = None
+        self._window_fired = set()
         return self
+
+    def set_step(self, step: int | None) -> None:
+        """Announce the driver's current panel step (``None`` = outside
+        any step scope).  Drivers call this through
+        ``engine.set_fault_step``; it gates ``window=`` rules only."""
+        self.step = None if step is None else int(step)
 
     # ---- the engine-facing entry ------------------------------------
     def apply(self, target: str, outputs: tuple) -> tuple:
@@ -124,12 +161,20 @@ class FaultPlan:
         corrupted) output arrays.  Tracer payloads pass through."""
         call = self.calls[target]
         self.calls[target] = call + 1
-        specs = [f for f in self.faults if f.matches(target, call)]
-        if not specs:
+        matched = [(si, f) for si, f in enumerate(self.faults)
+                   if f.matches(target, call, self.step)
+                   and not (f.window is not None and not f.every
+                            and si in self._window_fired)]
+        if not matched:
             return tuple(outputs)
         import jax
         if any(isinstance(o, jax.core.Tracer) for o in outputs):
             return tuple(outputs)         # inside jit: eager-only tool
+        specs = []
+        for si, f in matched:
+            if f.window is not None and not f.every:
+                self._window_fired.add(si)  # windowed one-shot: now spent
+            specs.append(f)
         out = list(outputs)
         for spec in specs:
             for oi, arr in enumerate(out):
@@ -158,7 +203,8 @@ class FaultPlan:
         self.log.append(FaultEvent(
             target=target, call=call, output=oi, kind=spec.kind,
             shape=tuple(host.shape), dtype=dt.name,
-            indices=idx, before=before, after=after.copy()))
+            indices=idx, before=before, after=after.copy(),
+            step=self.step))
         return new
 
     @staticmethod
@@ -201,9 +247,10 @@ def logs_identical(a: FaultPlan, b: FaultPlan) -> bool:
     if len(a.log) != len(b.log):
         return False
     for ea, eb in zip(a.log, b.log):
-        if (ea.target, ea.call, ea.output, ea.kind, ea.shape, ea.dtype) \
+        if (ea.target, ea.call, ea.output, ea.kind, ea.shape, ea.dtype,
+                ea.step) \
                 != (eb.target, eb.call, eb.output, eb.kind, eb.shape,
-                    eb.dtype):
+                    eb.dtype, eb.step):
             return False
         if not np.array_equal(ea.indices, eb.indices):
             return False

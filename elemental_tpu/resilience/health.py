@@ -121,6 +121,7 @@ class HealthMonitor:
         self._scale = None                # deferred jnp max |A|
         self._eps = None
         self._checks: list[_Check] = []
+        self._extra_flags: list[dict] = []
         self._emitted = False
         self._report = None
 
@@ -133,6 +134,7 @@ class HealthMonitor:
         import jax.numpy as jnp
         self.driver = str(driver)
         self._checks = []
+        self._extra_flags = []
         self._report = None
         self._emitted = False
         if scale_from is not None and not _is_tracer(scale_from):
@@ -172,6 +174,15 @@ class HealthMonitor:
         self._checks.append(_Check(str(phase), int(step), fin, mx,
                                    dmin, dsigned))
 
+    def flag(self, kind: str, phase: str, step: int, value=None) -> None:
+        """Append an externally-detected flag (ISSUE 11: the ABFT guard
+        pushes UNRECOVERED checksum violations here, kind ``"abft"``, so
+        they surface through the same ``health_report/v1`` document and
+        ``failing_phase`` plumbing as the monitor's own checks).  Must be
+        called before :meth:`report` caches."""
+        self._extra_flags.append({"kind": str(kind), "phase": str(phase),
+                                  "step": int(step), "value": value})
+
     # ---- report ------------------------------------------------------
     @property
     def checks(self) -> int:
@@ -185,7 +196,7 @@ class HealthMonitor:
         active tracer; later calls return the cached document."""
         if self._report is not None:
             return self._report
-        flags = []
+        flags = list(self._extra_flags)
         scale = float(np.asarray(self._scale)) if self._scale is not None \
             else None
         gmax = None

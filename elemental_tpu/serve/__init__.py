@@ -34,7 +34,7 @@ from .policy import (CLOSED, HALF_OPEN, OPEN, CircuitBreaker, RetryPolicy,
                      select_ladder)
 from .service import RESULT_SCHEMA, SolverService
 from .chaos import (CHAOS_SCHEMA, build_workload, chaos_matrix,
-                    replay_identical, run_cell)
+                    replay_identical, run_cell, run_qr_cell)
 
 __all__ = [
     "REJECT_SCHEMA", "AdmissionController", "Bucket", "Deadline",
@@ -45,5 +45,5 @@ __all__ = [
     "select_ladder",
     "RESULT_SCHEMA", "SolverService",
     "CHAOS_SCHEMA", "build_workload", "chaos_matrix", "replay_identical",
-    "run_cell",
+    "run_cell", "run_qr_cell",
 ]

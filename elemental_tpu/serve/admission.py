@@ -42,9 +42,11 @@ from ..tune.cache import shape_bucket
 
 REJECT_SCHEMA = "serve_reject/v1"
 
-#: reject reasons (pinned by tests/serve)
+#: reject reasons (pinned by tests/serve).  'shutdown' (ISSUE 11) marks
+#: requests flushed by ``SolverService.shutdown`` -- queued work that was
+#: NOT executed gets this structured reject instead of being dropped.
 REJECT_REASONS = ("queue_pressure", "deadline_expired", "breaker_open",
-                  "bad_request")
+                  "bad_request", "shutdown")
 
 #: cold-start throughput assumption for the flops-based cost seed,
 #: flop/s.  Deliberately modest (CPU-class): a cold service sheds

@@ -29,6 +29,12 @@ executor's solve output crosses the compute seam -- call 0 is the first
 batch); ``redistribute`` / ``panel_spread`` cells run ``fastpath=False``
 so every request exercises the distributed certified path where the
 engine seams live (the big-problem serving mode).
+
+ISSUE 11 grows the matrix a ``qr`` op column (:func:`run_qr_cell`):
+serve admission only solves lu/hpd, so the qr cells drive
+``qr(..., health=True)`` directly under the same fault axes and grade
+detection against the ISSUE-9 health parity (see
+:data:`QR_DETECTED_KINDS` for the honest contract).
 """
 from __future__ import annotations
 
@@ -186,10 +192,75 @@ def _classify(svc, plan, workload, ids, *, kind, target, mode, op,
             "verdict": verdict, "violations": violations}
 
 
+#: the qr column's detection contract (ISSUE 11, riding ISSUE 9's
+#: qr health parity): 'nan' is caught by the nonfinite scan and 'scale'
+#: (x1e12) by the growth estimate -- a SILENT undetected corruption for
+#: either is a matrix violation.  'bitflip' is recorded but NOT gated:
+#: an exponent-bit flip that SHRINKS an element sits below the growth
+#: threshold, and catching it needs ABFT checksum checks -- which lu /
+#: cholesky now run (``abft=``) and qr does not yet (ROADMAP).
+QR_DETECTED_KINDS = ("scale", "nan")
+
+
+def run_qr_cell(grid, *, kind: str, target: str, n: int = 24,
+                nb: int = 8, call: int = 0, nelem: int = 2,
+                seed: int = 13):
+    """One qr-column cell: ``qr(..., health=True)`` under a one-shot
+    fault, classified against a clean reference run.
+
+    qr has no serve admission path (the service solves 'lu'/'hpd'), so
+    the column runs the driver directly: verdicts are ``absorbed`` (the
+    factor matches the clean run), ``surfaced`` (corrupted AND health
+    flagged it), or ``undetected`` (corrupted, no flag) -- the last is a
+    violation for :data:`QR_DETECTED_KINDS`.  Returns ``(cell, plan)``."""
+    import jax
+    import elemental_tpu as el
+    from ..core.distmatrix import to_global
+    from ..resilience.health import HealthMonitor
+
+    dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
+    rng = np.random.default_rng(seed)
+    An = rng.normal(size=(n, n)).astype(dtype)
+    clean = np.asarray(to_global(
+        el.qr(el.from_global(An, el.MC, el.MR, grid=grid), nb=nb)[0]))
+    plan = FaultPlan(seed=seed, faults=[
+        FaultSpec(target, kind, call=call, nelem=nelem)])
+    mon = HealthMonitor()
+    with fault_injection(plan):
+        out = el.qr(el.from_global(An, el.MC, el.MR, grid=grid), nb=nb,
+                    health=mon)
+    rep = mon.report()
+    got = np.asarray(to_global(out[0]))
+    with np.errstate(over="ignore", invalid="ignore"):
+        same = bool(np.allclose(got, clean, rtol=1e-6, atol=1e-9,
+                                equal_nan=False))
+    detected = rep["ok"] is False
+    verdict = "absorbed" if same else \
+        ("surfaced" if detected else "undetected")
+    violations = []
+    if plan.fired() == 0:
+        violations.append({"kind": "vacuous",
+                           "detail": "fault never landed"})
+    if verdict == "undetected" and kind in QR_DETECTED_KINDS:
+        violations.append({"kind": "silent_garbage",
+                           "detail": f"qr {kind} corruption unflagged by "
+                                     f"health parity"})
+    return {"kind": kind, "target": target, "mode": "oneshot",
+            "op": "qr", "requests": 1, "ok": int(same),
+            "fired": plan.fired(), "budget_s": None,
+            "outcomes": {"qr": verdict}, "verdict": verdict,
+            "health_flags": [f["kind"] for f in rep["flags"]],
+            "violations": violations}, plan
+
+
 def chaos_matrix(grid, *, kinds=FAULT_KINDS, targets=CHAOS_TARGETS,
                  modes=CHAOS_MODES, seed: int = 13, n: int = 16,
-                 requests: int = 4, **kw):
-    """The full acceptance matrix -> ``chaos_report/v1``."""
+                 requests: int = 4, qr_column: bool = True, **kw):
+    """The full acceptance matrix -> ``chaos_report/v1``.
+
+    ``qr_column=True`` (default) appends the ISSUE-11 qr op column:
+    one :func:`run_qr_cell` per (kind, target), detection via the
+    ISSUE-9 health parity (see :data:`QR_DETECTED_KINDS`)."""
     cells = []
     nviol = 0
     vacuous = 0
@@ -205,6 +276,15 @@ def chaos_matrix(grid, *, kinds=FAULT_KINDS, targets=CHAOS_TARGETS,
                     cell["violations"].append(
                         {"kind": "vacuous",
                          "detail": "fault never landed"})
+                nviol += len(cell["violations"])
+                cells.append(cell)
+    if qr_column:
+        for target in targets:
+            for kind in kinds:
+                cell, _ = run_qr_cell(grid, kind=kind, target=target,
+                                      seed=seed)
+                if cell["fired"] == 0:
+                    vacuous += 1
                 nviol += len(cell["violations"])
                 cells.append(cell)
     return {"schema": CHAOS_SCHEMA, "grid": [grid.height, grid.width],

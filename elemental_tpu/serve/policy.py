@@ -152,7 +152,13 @@ def select_ladder(op: str, pressure: float,
     ``degrade_pressure`` the FULL ladder runs, quant rung first (cheap
     narrow wire, refinement pays it back); below it the quant rung is
     skipped -- full-precision wire straight away, nothing to refine
-    back.  Deadline enforcement happens inside ``certified_solve``."""
+    back.  Deadline enforcement happens inside ``certified_solve``.
+
+    Every returned ladder includes the 'abft' rung (ISSUE 11) ahead of
+    the fp32/classic refactorizations: a batch member that failed on a
+    TRANSIENT fault gets panel-granular checksum recovery -- one
+    recomputed panel inside the guarded driver -- before the service
+    pays for bisect re-execution or a whole-solve escalation."""
     rungs = default_ladder(op)
     if pressure >= degrade_pressure:
         return rungs

@@ -81,8 +81,9 @@ class SolverService:
                                  seed=seed)
         self.breakers: dict = {}         # bucket.key() -> CircuitBreaker
         self._queues: dict = {}          # Bucket -> [SolveRequest]
-        self.results: dict = {}          # id -> serve_result/v1
+        self.results: dict = {}          # id -> serve_result/v1 | reject
         self.solutions: dict = {}        # id -> np.ndarray
+        self._shutdown = False           # set by shutdown(); rejects submits
 
     # ---- bookkeeping -------------------------------------------------
     def _grid(self):
@@ -123,6 +124,12 @@ class SolverService:
         expired deadline, open breaker, malformed request)."""
         if deadline is None and budget_s is not None:
             deadline = Deadline(budget_s, clock=self.clock)
+        if self._shutdown:
+            rej = reject_doc("shutdown", queue_depth=self.queue_depth(),
+                             deadline=deadline,
+                             detail="service has shut down")
+            _metrics.inc("serve_rejects", reason="shutdown")
+            return rej
         req = self.admission.admit(op, A, B, deadline=deadline,
                                    queue_depth=self.queue_depth)
         if isinstance(req, dict):        # bad_request / expired / shed
@@ -170,6 +177,35 @@ class SolverService:
         for rid, doc in self.results.items():
             if rid not in before:
                 done[rid] = doc
+        self._gauges()
+        return done
+
+    def shutdown(self, drain: bool = True) -> dict:
+        """Graceful stop (ISSUE 11): nothing queued is dropped silently.
+
+        With ``drain=True`` (default) the queue is processed to
+        completion first -- every queued request finishes through the
+        normal path.  With ``drain=False`` (emergency stop) queued
+        requests are flushed UNEXECUTED: each gets a structured
+        ``serve_reject/v1`` with ``reason='shutdown'`` (plus its request
+        ``id``) recorded in :attr:`results`.  Either way the service
+        then rejects new ``submit`` calls with ``reason='shutdown'``
+        and ``shutdown`` is idempotent.  Returns ``{id: doc}`` for every
+        request settled by this call."""
+        done: dict = {}
+        if drain:
+            done.update(self.drain())
+        for bucket in sorted(self._queues, key=lambda b: b.key()):
+            for req in self._queues[bucket]:
+                rej = reject_doc("shutdown", bucket=bucket,
+                                 queue_depth=0, deadline=req.deadline,
+                                 detail="flushed by shutdown(drain=False)")
+                rej["id"] = req.id
+                self.results[req.id] = rej
+                done[req.id] = rej
+                _metrics.inc("serve_rejects", reason="shutdown")
+        self._queues.clear()
+        self._shutdown = True
         self._gauges()
         return done
 

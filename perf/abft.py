@@ -1,0 +1,245 @@
+"""ABFT-guarded factorization CLI (ISSUE 11): run a checksum-guarded
+lu/cholesky, print the ``abft_report/v1``; optionally under
+deterministic (windowed) fault injection.
+
+The command-line face of ``elemental_tpu/resilience/abft``:
+
+    python -m perf.abft run lu 256 --grid 2x2
+                                            # lu(..., abft=True): one
+                                            #   abft_report/v1 line on
+                                            #   stdout, human summary
+                                            #   rows # -prefixed
+    python -m perf.abft run hpd --n 128 --nb 32 --comm-precision bf16
+                                            # quantized wire: widened
+                                            #   thresholds, still zero
+                                            #   violations on clean data
+    python -m perf.abft run lu --fault redistribute:nan --window 1:2
+                                            # corrupt panel step 1; watch
+                                            #   detection AND the single
+                                            #   panel re-execution
+    python -m perf.abft smoke               # the tools/check.sh gate:
+                                            #   clean guarded runs on 1x1
+                                            #   AND 2x2 for lu+cholesky
+                                            #   (zero violations), plus
+                                            #   one injected fault per op
+                                            #   recovered at panel
+                                            #   granularity (recompute
+                                            #   count pinned to 1); exit 1
+                                            #   on any violation
+
+``--fault`` is ``target:kind[:call[:every]]`` (see ``resilience.faults``);
+``--window start:stop`` scopes the LAST ``--fault`` to those panel steps.
+Runs are CPU-safe: the same virtual 8-device host mesh as ``perf.trace``.
+
+Flags for ``run``: ``--n N`` (or positional; default 128), ``--nb NB``
+(default 32), ``--grid RxC``, ``--dtype NAME``, ``--comm-precision P``,
+``--seed S``, ``--fault SPEC`` (repeatable), ``--window A:B``,
+``--retries K``, ``--json`` (report only, no summary rows).
+"""
+import json
+import sys
+import time
+
+from .trace import _bootstrap, _grid
+
+
+def _build(op, n, dtype, grid):
+    import numpy as np
+    import elemental_tpu as el
+    rng = np.random.default_rng(0)
+    F = rng.normal(size=(n, n)).astype(dtype)
+    M = (F @ F.T / n + n * np.eye(n)).astype(dtype) if op == "hpd" \
+        else (F + n * np.eye(n, dtype=dtype))
+    return M, el.from_global(M, el.MC, el.MR, grid=grid)
+
+
+def _residual(op, M, out):
+    import numpy as np
+    import elemental_tpu as el
+    n = M.shape[0]
+    if op == "lu":
+        LU, perm = out
+        g = np.asarray(el.to_global(LU))
+        L = np.tril(g, -1) + np.eye(n, dtype=g.dtype)
+        U = np.triu(g)
+        return float(np.linalg.norm(M[np.asarray(perm)] - L @ U)
+                     / np.linalg.norm(M))
+    Lg = np.asarray(el.to_global(out))
+    return float(np.linalg.norm(M - Lg @ Lg.conj().T) / np.linalg.norm(M))
+
+
+def _run_one(op, n, nb, grid, dtype, faults, seed, retries,
+             comm_precision=None):
+    """One guarded factorization; returns (report, residual, plan, secs)."""
+    import elemental_tpu as el
+    from elemental_tpu.resilience import (AbftGuard, FaultPlan,
+                                          fault_injection)
+    M, A = _build(op, n, dtype, grid)
+    guard = AbftGuard(max_retries=retries)
+    drv = (lambda: el.lu(A, nb=nb, abft=guard,
+                         comm_precision=comm_precision)) if op == "lu" \
+        else (lambda: el.cholesky(A, nb=nb, abft=guard,
+                                  comm_precision=comm_precision))
+    t0 = time.perf_counter()
+    if faults:
+        plan = FaultPlan(seed=seed, faults=faults)
+        with fault_injection(plan):
+            out = drv()
+    else:
+        plan = None
+        out = drv()
+    secs = time.perf_counter() - t0
+    return guard.report(), _residual(op, M, out), plan, secs
+
+
+def _parse_fault(spec: str):
+    from elemental_tpu.resilience import FaultSpec
+    parts = spec.split(":")
+    if len(parts) < 2:
+        raise SystemExit(f"--fault needs target:kind[:call[:every]], "
+                         f"got {spec!r}")
+    call = int(parts[2]) if len(parts) > 2 else 0
+    every = len(parts) > 3 and parts[3] == "every"
+    return FaultSpec(target=parts[0], kind=parts[1], call=call, every=every)
+
+
+def cmd_run(op, n, nb, grid_spec, dtype, faults, seed, retries,
+            comm_precision, as_json) -> int:
+    grid = _grid(grid_spec)
+    rep, res, plan, secs = _run_one(op, n, nb, grid, dtype, faults, seed,
+                                    retries, comm_precision)
+    if not as_json:
+        print(f"# abft {op} n={n} nb={nb} "
+              f"grid={grid.height}x{grid.width} "
+              f"quantized_wire={rep['quantized_wire']} "
+              f"wall={secs:.3f}s")
+        print(f"#   panels={rep['panels']} checks={rep['checks']} "
+              f"violations={len(rep['violations'])} "
+              f"recompute_count={rep['recompute_count']} "
+              f"recovered={rep['recovered_panels']} "
+              f"unrecovered={rep['unrecovered_panels']}")
+        for v in rep["violations"]:
+            print(f"#   step={v['step']} attempt={v['attempt']} "
+                  f"phase={v['phase']} kind={v['kind']} "
+                  f"nonfinite={v['nonfinite']} columns={v['columns']}")
+        if plan is not None:
+            print(f"# faults fired: {plan.fired()} "
+                  f"({json.dumps(plan.summary())})")
+        print(f"# residual={res:.3e} -> "
+              f"{'OK' if rep['ok'] else 'UNRECOVERED'}")
+    print(json.dumps(rep))
+    return 0 if rep["ok"] else 1
+
+
+def cmd_smoke() -> int:
+    """The check.sh gate: clean guarded runs on 1x1 and 2x2 for both ops
+    (zero violations, zero recomputes) + one windowed fault per op that
+    must be detected at the injected panel and repaired by exactly ONE
+    panel re-execution.  Small n, CPU-safe, exit 1 on any violation."""
+    from elemental_tpu.resilience import FaultSpec
+    rc = 0
+    n, nb = 32, 8
+    for spec in ("1x1", "2x2"):
+        grid = _grid(spec)
+        for op in ("lu", "hpd"):
+            rep, res, _, secs = _run_one(op, n, nb, grid, "float32", (),
+                                         0, 2)
+            clean = (rep["ok"] and not rep["violations"]
+                     and rep["recompute_count"] == 0 and res < 1e-4)
+            print(f"# smoke {op} {spec}: checks={rep['checks']} "
+                  f"violations={len(rep['violations'])} "
+                  f"residual={res:.2e} wall={secs:.3f}s "
+                  f"{'ok' if clean else 'FAILED'}")
+            if not clean:
+                rc = 1
+    # one injected fault per op on the 2x2 grid: panel-granular recovery
+    grid = _grid("2x2")
+    for op, target in (("lu", "redistribute"), ("hpd", "compute")):
+        fault = FaultSpec(target, "scale", nelem=2, window=(1, 2))
+        rep, res, plan, _ = _run_one(op, n, nb, grid, "float32", (fault,),
+                                     7, 2)
+        steps = sorted({v["step"] for v in rep["violations"]})
+        good = (plan.fired() >= 1 and steps == [1]
+                and rep["recompute_count"] == 1
+                and rep["recovered_panels"] == [1]
+                and rep["ok"] and res < 1e-4)
+        print(f"# smoke fault({op} {target} scale@panel1): "
+              f"fired={plan.fired()} viol_steps={steps} "
+              f"recompute={rep['recompute_count']} "
+              f"recovered={rep['recovered_panels']} residual={res:.2e} "
+              f"{'ok' if good else 'FAILED'}")
+        if not good:
+            rc = 1
+    print("# abft smoke:", "ok" if rc == 0 else "FAILED")
+    return rc
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    cmd = argv.pop(0)
+    if cmd not in ("run", "smoke"):
+        print(__doc__)
+        raise SystemExit(f"unknown command {cmd!r}")
+    if cmd == "smoke":
+        _bootstrap()
+        return cmd_smoke()
+    pos = []
+    n = nb = None
+    grid_spec = None
+    dtype, seed, retries, as_json = "float32", 0, 2, False
+    comm_precision = None
+    faults = []
+    window = None
+    it = iter(argv)
+    for arg in it:
+        if arg == "--n":
+            n = int(next(it))
+        elif arg == "--nb":
+            nb = int(next(it))
+        elif arg == "--grid":
+            grid_spec = next(it)
+        elif arg == "--dtype":
+            dtype = next(it)
+        elif arg == "--seed":
+            seed = int(next(it))
+        elif arg == "--retries":
+            retries = int(next(it))
+        elif arg == "--comm-precision":
+            comm_precision = next(it)
+        elif arg == "--fault":
+            faults.append(next(it))    # parsed after _bootstrap
+        elif arg == "--window":
+            window = tuple(int(x) for x in next(it).split(":"))
+        elif arg == "--json":
+            as_json = True
+        elif arg.startswith("--"):
+            raise SystemExit(f"unknown flag {arg!r}")
+        else:
+            pos.append(arg)
+    if not pos:
+        raise SystemExit("run needs an op (lu/hpd)")
+    op = pos.pop(0)
+    if op == "cholesky":
+        op = "hpd"
+    if op not in ("lu", "hpd"):
+        raise SystemExit(f"unknown op {op!r}; expected lu or hpd")
+    if pos and n is None:
+        n = int(pos.pop(0))
+    n = 128 if n is None else n
+    nb = 32 if nb is None else nb
+    _bootstrap()
+    fspecs = [_parse_fault(s) for s in faults]
+    if window is not None:
+        if not fspecs:
+            raise SystemExit("--window needs a preceding --fault")
+        import dataclasses
+        fspecs[-1] = dataclasses.replace(fspecs[-1], window=window)
+    return cmd_run(op, n, nb, grid_spec, dtype, tuple(fspecs), seed,
+                   retries, comm_precision, as_json)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -155,8 +155,8 @@ def cmd_chaos(seed) -> int:
     grid = _grid("2x2")
     report = chaos_matrix(grid, seed=seed)
     for cell in report["cells"]:
-        print(f"# {cell['target']:12s} {cell['kind']:8s} "
-              f"{cell['mode']:10s} -> {cell['verdict']:9s} "
+        print(f"# {cell['op']:3s} {cell['target']:12s} {cell['kind']:8s} "
+              f"{cell['mode']:10s} -> {cell['verdict']:10s} "
               f"ok={cell['ok']}/{cell['requests']} fired={cell['fired']} "
               f"violations={len(cell['violations'])}")
     replay = replay_identical(grid, seed=seed + 16)

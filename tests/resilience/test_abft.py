@@ -1,0 +1,242 @@
+"""ABFT checksum-guarded factorizations (ISSUE 11): the acceptance
+matrix {bitflip, scale, nan} x {redistribute, compute} inside
+abft-enabled lu/cholesky detects at the injected panel and recovers by
+re-executing ONLY that panel (recompute_count == 1), the abft=None path
+is bit-identical to the plain drivers, quantized wire produces no false
+positives, and unrecovered persistent faults surface through
+health_report/v1."""
+import numpy as np
+import pytest
+
+import elemental_tpu as el
+from elemental_tpu import MC, MR, from_global, to_global
+from elemental_tpu.obs import Tracer, metrics_scope
+from elemental_tpu.resilience import (ABFT_SCHEMA, AbftGuard, FaultPlan,
+                                      FaultSpec, HealthMonitor,
+                                      fault_injection, last_abft_report)
+
+
+def _build(op, n, dtype=np.float32, seed=0):
+    """A well-conditioned host matrix + its MC/MR distribution."""
+    rng = np.random.default_rng(seed)
+    F = rng.standard_normal((n, n)).astype(dtype)
+    M = F @ F.T / n + n * np.eye(n, dtype=dtype) if op == "hpd" \
+        else F + n * np.eye(n, dtype=dtype)
+    return M
+
+
+def _dist(g, arr):
+    return from_global(arr, MC, MR, grid=g)
+
+
+def _lu_residual(M, LU, perm):
+    n = M.shape[0]
+    lu_g = np.asarray(to_global(LU))
+    L = np.tril(lu_g, -1) + np.eye(n, dtype=lu_g.dtype)
+    U = np.triu(lu_g)
+    return np.linalg.norm(M[np.asarray(perm)] - L @ U) / np.linalg.norm(M)
+
+
+def _chol_residual(M, Lc):
+    Lg = np.asarray(to_global(Lc))
+    return np.linalg.norm(M - Lg @ Lg.conj().T) / np.linalg.norm(M)
+
+
+# ---------------------------------------------------------------------
+# clean guarded runs: ok reports, zero violations, bitwise-plain output
+# ---------------------------------------------------------------------
+
+def test_clean_lu_abft_ok(grid24):
+    M = _build("lu", 16)
+    LU, perm = el.lu(_dist(grid24, M), nb=4, abft=True)
+    rep = last_abft_report("lu")
+    assert rep["schema"] == ABFT_SCHEMA
+    assert rep["ok"] is True and rep["driver"] == "lu"
+    assert rep["panels"] == 4 and rep["checks"] > 0
+    assert rep["violations"] == [] and rep["recompute_count"] == 0
+    assert rep["quantized_wire"] is False
+    assert _lu_residual(M, LU, perm) < 1e-5
+
+
+def test_clean_cholesky_abft_ok(grid24):
+    M = _build("hpd", 16)
+    Lc = el.cholesky(_dist(grid24, M), nb=4, abft=True)
+    rep = last_abft_report("cholesky")
+    assert rep["ok"] is True and rep["driver"] == "cholesky"
+    assert rep["violations"] == [] and rep["recompute_count"] == 0
+    assert _chol_residual(M, Lc) < 1e-5
+
+
+def test_report_schema_pin(grid24):
+    el.lu(_dist(grid24, _build("lu", 16)), nb=4, abft=True)
+    rep = last_abft_report("lu")
+    assert set(rep) == {"schema", "driver", "ok", "panels", "checks",
+                        "violations", "recovered_panels",
+                        "unrecovered_panels", "recompute_count",
+                        "max_retries", "quantized_wire"}
+
+
+def test_abft_true_output_bitwise_plain(grid24):
+    """The guarded path only OBSERVES: checksum maintenance never
+    perturbs the factorization itself.  abft forces the classic
+    right-looking schedule, so the bitwise reference is lookahead=False
+    (the lookahead pipeline reorders last-bit rounding)."""
+    M = _build("lu", 16, dtype=np.float64, seed=3)
+    LU0, p0 = el.lu(_dist(grid24, M), nb=4, lookahead=False)
+    LU1, p1 = el.lu(_dist(grid24, M), nb=4, abft=True)
+    np.testing.assert_array_equal(np.asarray(to_global(LU0)),
+                                  np.asarray(to_global(LU1)))
+    np.testing.assert_array_equal(np.asarray(p0), np.asarray(p1))
+    S = _build("hpd", 16, dtype=np.float64, seed=3)
+    np.testing.assert_array_equal(
+        np.asarray(to_global(el.cholesky(_dist(grid24, S), nb=4,
+                                         lookahead=False))),
+        np.asarray(to_global(el.cholesky(_dist(grid24, S), nb=4,
+                                         abft=True))))
+
+
+def test_abft_none_is_plain_dispatch(grid24):
+    """abft=None is the NULL path: same code, bit-identical output."""
+    M = _build("lu", 16, dtype=np.float64, seed=5)
+    LU0, _ = el.lu(_dist(grid24, M), nb=8)
+    LU1, _ = el.lu(_dist(grid24, M), nb=8, abft=None)
+    np.testing.assert_array_equal(np.asarray(to_global(LU0)),
+                                  np.asarray(to_global(LU1)))
+
+
+# ---------------------------------------------------------------------
+# THE ACCEPTANCE MATRIX: one-shot {bitflip, scale, nan} x
+# {redistribute, compute} inside the guarded drivers -> detected at the
+# injected panel, recovered by re-executing ONLY that panel.
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("kind", ["bitflip", "scale", "nan"])
+@pytest.mark.parametrize("target", ["redistribute", "compute"])
+@pytest.mark.parametrize("op", ["lu", "hpd"])
+def test_acceptance_matrix_panel_recovery(grid24, op, target, kind):
+    """The ISSUE-11 acceptance pin: a one-shot fault scoped to panel
+    step 1 is detected AT step 1 and repaired by exactly ONE panel
+    re-execution (the recovery-cost counter), with a clean factor."""
+    n = 16
+    M = _build(op, n)
+    plan = FaultPlan(seed=7, faults=[
+        FaultSpec(target, kind, nelem=2, window=(1, 2))])
+    with fault_injection(plan):
+        if op == "lu":
+            LU, perm = el.lu(_dist(grid24, M), nb=4, abft=True)
+            rep = last_abft_report("lu")
+            res = _lu_residual(M, LU, perm)
+        else:
+            Lc = el.cholesky(_dist(grid24, M), nb=4, abft=True)
+            rep = last_abft_report("cholesky")
+            res = _chol_residual(M, Lc)
+    assert plan.fired() >= 1, "fault never landed: the cell is vacuous"
+    assert sorted({v["step"] for v in rep["violations"]}) == [1]
+    assert rep["recompute_count"] == 1       # ONLY the corrupted panel
+    assert rep["recovered_panels"] == [1]
+    assert rep["unrecovered_panels"] == []
+    assert rep["ok"] is True
+    assert res < 1e-5
+
+
+def test_violation_doc_shape(grid24):
+    M = _build("lu", 16)
+    plan = FaultPlan(seed=7, faults=[
+        FaultSpec("redistribute", "nan", nelem=2, window=(1, 2))])
+    with fault_injection(plan):
+        el.lu(_dist(grid24, M), nb=4, abft=True)
+    rep = last_abft_report("lu")
+    assert rep["violations"]
+    for v in rep["violations"]:
+        assert set(v) == {"step", "attempt", "phase", "kind", "value",
+                          "nonfinite", "columns"}
+        assert v["step"] == 1 and v["attempt"] == 0
+
+
+# ---------------------------------------------------------------------
+# quantized wire: the widened threshold absorbs block-scaled rounding
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("op", ["lu", "hpd"])
+def test_quantized_wire_no_false_positives(grid24, op):
+    M = _build(op, 32, dtype=np.float64, seed=9)
+    if op == "lu":
+        el.lu(_dist(grid24, M), nb=8, abft=True, comm_precision="bf16")
+        rep = last_abft_report("lu")
+    else:
+        el.cholesky(_dist(grid24, M), nb=8, abft=True,
+                    comm_precision="bf16")
+        rep = last_abft_report("cholesky")
+    assert rep["quantized_wire"] is True
+    assert rep["violations"] == [] and rep["ok"] is True
+
+
+# ---------------------------------------------------------------------
+# persistent faults: retries exhaust, the panel commits UNRECOVERED and
+# surfaces through the bound health monitor
+# ---------------------------------------------------------------------
+
+def test_persistent_fault_surfaces_through_health(grid24):
+    M = _build("lu", 16)
+    mon = HealthMonitor()
+    plan = FaultPlan(seed=7, faults=[
+        FaultSpec("redistribute", "nan", every=True, nelem=2)])
+    with fault_injection(plan):
+        el.lu(_dist(grid24, M), nb=4, abft=AbftGuard(max_retries=1),
+              health=mon)
+    rep = last_abft_report("lu")
+    assert rep["ok"] is False
+    assert rep["unrecovered_panels"]
+    # every unrecovered step burned the full retry budget
+    assert rep["recompute_count"] >= rep["max_retries"]
+    hrep = mon.report()
+    assert hrep["ok"] is False
+    flags = [f for f in hrep["flags"] if f["kind"] == "abft"]
+    assert flags
+    assert hrep["failing_phase"] == flags[0]["phase"]
+
+
+# ---------------------------------------------------------------------
+# observability: metrics counters + the abft:recover span
+# ---------------------------------------------------------------------
+
+def test_metrics_emitted(grid24):
+    M = _build("lu", 16)
+    plan = FaultPlan(seed=7, faults=[
+        FaultSpec("compute", "scale", nelem=2, window=(1, 2))])
+    with metrics_scope() as reg:
+        with fault_injection(plan):
+            el.lu(_dist(grid24, M), nb=4, abft=True)
+        rep = last_abft_report("lu")
+        assert reg.counter_value("abft_checks", driver="lu") \
+            == rep["checks"]
+        assert reg.counter_value("abft_violations", driver="lu") \
+            == len(rep["violations"])
+        assert reg.counter_value("abft_recovered_panels", driver="lu") == 1
+
+
+def test_recovery_span_on_tracer(grid24):
+    M = _build("hpd", 16)
+    plan = FaultPlan(seed=7, faults=[
+        FaultSpec("compute", "nan", nelem=2, window=(1, 2))])
+    tr = Tracer()
+    with tr:
+        with fault_injection(plan):
+            el.cholesky(_dist(grid24, M), nb=4, abft=True)
+    spans = [s for s in tr.spans if s.name == "abft:recover"]
+    assert len(spans) == 1                   # one retry, one span
+    assert spans[0].attrs["step"] == 1 and spans[0].attrs["attempt"] == 1
+    assert spans[0].attrs["violated"]
+
+
+# ---------------------------------------------------------------------
+# guard plumbing: explicit AbftGuard pass-through + report retrieval
+# ---------------------------------------------------------------------
+
+def test_explicit_guard_passthrough(grid24):
+    g = AbftGuard(max_retries=1)
+    el.lu(_dist(grid24, _build("lu", 16)), nb=4, abft=g)
+    rep = g.report()
+    assert rep["driver"] == "lu" and rep["max_retries"] == 1
+    assert last_abft_report("lu") is rep
+    assert last_abft_report() is rep         # the "_latest" alias

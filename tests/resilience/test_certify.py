@@ -28,16 +28,24 @@ def _clean_resid(An, Bn, X):
 
 
 # ---------------------------------------------------------------------
-# the ladder itself is pinned: quant -> fast -> refine -> fp32 -> classic
+# the ladder itself is pinned:
+#   quant -> fast -> refine -> abft -> fp32 -> classic
 # ---------------------------------------------------------------------
 
 def test_ladder_order_pinned():
-    assert LADDER_NAMES == ("quant", "fast", "refine", "fp32", "classic")
+    assert LADDER_NAMES == ("quant", "fast", "refine", "abft", "fp32",
+                            "classic")
     for op in ("lu", "hpd"):
         rungs = default_ladder(op)
         assert tuple(r.name for r in rungs) == LADDER_NAMES
         # 'refine' escalates WITHOUT refactorization; the rest refactor
-        assert [r.refactor for r in rungs] == [True, True, False, True, True]
+        assert [r.refactor for r in rungs] == [True, True, False, True,
+                                               True, True]
+        # the abft rung (ISSUE 11) re-factors under the checksum-guarded
+        # schedule: panel-granular recovery before full-ladder escalation
+        ab = rungs[3]
+        assert ab.config.get("abft") is True
+        assert "comm_precision" not in ab.config    # attested rung
         # the quant rung (ISSUE 8) is the wire-quantized twin of 'fast':
         # int8 comm_precision, a refinement budget sized for the
         # quantization error, and NO other config difference
@@ -54,7 +62,7 @@ def test_ladder_order_pinned():
     tunable = set(OPS["lu"].knobs)
     for r in lu_rungs:
         assert set(r.config) <= tunable | {"update_precision", "precision",
-                                           "lookahead"}
+                                           "lookahead", "abft"}
 
 
 # ---------------------------------------------------------------------

@@ -178,9 +178,11 @@ def test_fault_matrix_no_silent_garbage(grid24, target, kind, mode):
 def test_oneshot_fault_escalation_order_pinned(grid24):
     """One-shot NaNs on the first TWO panel_spreads corrupt the 'quant'
     and 'fast' factors (one spread per factorization at this geometry);
-    'refine' (sharing fast's factor) cannot fix it; 'fp32' refactors
-    cleanly and certifies -- the ladder order quant -> fast -> refine ->
-    fp32 pinned, including the shares-the-factor semantics of 'refine'."""
+    'refine' (sharing fast's factor) cannot fix it; 'abft' (ISSUE 11)
+    refactors under the checksum-guarded schedule -- the one-shot faults
+    are spent, so it certifies BEFORE the fp32 escalation -- the ladder
+    order quant -> fast -> refine -> abft pinned, including the
+    shares-the-factor semantics of 'refine'."""
     rng = np.random.default_rng(106)
     An, Bn = _problem(rng, 24, "hpd")
     plan = FaultPlan(seed=5, faults=[FaultSpec("panel_spread", "nan",
@@ -191,9 +193,9 @@ def test_oneshot_fault_escalation_order_pinned(grid24):
         X, info = certified_solve("hpd", _dist(grid24, An),
                                   _dist(grid24, Bn), nb=8)
     assert info["certified"] is True
-    assert info["rung"] == "fp32"
+    assert info["rung"] == "abft"
     assert [a["rung"] for a in info["attempts"]] == ["quant", "fast",
-                                                     "refine", "fp32"]
+                                                     "refine", "abft"]
     assert _clean_resid(An, Bn, X) <= info["tol"]
     # the corrupted attempts carry their health evidence
     assert info["attempts"][0]["health"]["ok"] is False
@@ -214,7 +216,7 @@ def test_persistent_corruption_surfaced_with_phase(grid24):
     assert info["failing_phase"] is not None
     assert info["health"] is not None
     assert [a["rung"] for a in info["attempts"]] \
-        == ["quant", "fast", "refine", "fp32", "classic"]
+        == ["quant", "fast", "refine", "abft", "fp32", "classic"]
 
 
 # ---------------------------------------------------------------------
@@ -321,3 +323,65 @@ def test_compute_fault_matrix_certified_or_surfaced(grid24, mode):
         assert _clean_resid(An, Bn, X) <= info["tol"]
     else:
         assert info["failing_phase"] is not None
+
+
+# ---------------------------------------------------------------------
+# step-scoped (windowed) rules (ISSUE 11): the injection vehicle the
+# ABFT panel-recovery acceptance tests drive
+# ---------------------------------------------------------------------
+
+def test_window_validation():
+    with pytest.raises(ValueError):
+        FaultSpec("redistribute", "nan", window=(2, 1))
+    with pytest.raises(ValueError):
+        FaultSpec("redistribute", "nan", window=(-1, 3))
+    with pytest.raises(ValueError):
+        FaultSpec("redistribute", "nan", window=(0,))
+    assert FaultSpec("redistribute", "nan", window=(1, 2)).window == (1, 2)
+
+
+def test_window_scopes_to_announced_steps(grid24):
+    """A windowed rule fires ONLY inside its panel-step window, exactly
+    once when one-shot -- and never outside a set_fault_step scope (a
+    plain unguarded driver announces no steps)."""
+    rng = np.random.default_rng(124)
+    arr = (rng.normal(size=(16, 16)) + 16 * np.eye(16)).astype(np.float32)
+    # plain lu announces no steps: the windowed rule is inert
+    plan = FaultPlan(seed=7, faults=[
+        FaultSpec("redistribute", "nan", nelem=2, window=(1, 2))])
+    with fault_injection(plan):
+        el.lu(_dist(grid24, arr), nb=4)
+    assert plan.fired() == 0
+    # the abft driver announces steps: in-window fires once...
+    plan = FaultPlan(seed=7, faults=[
+        FaultSpec("redistribute", "nan", nelem=2, window=(1, 2))])
+    with fault_injection(plan):
+        el.lu(_dist(grid24, arr), nb=4, abft=True)
+    assert plan.fired() == 1
+    assert all(e.step == 1 for e in plan.log)
+    # ...and an out-of-range window never does
+    plan = FaultPlan(seed=7, faults=[
+        FaultSpec("redistribute", "nan", nelem=2, window=(99, 100))])
+    with fault_injection(plan):
+        el.lu(_dist(grid24, arr), nb=4, abft=True)
+    assert plan.fired() == 0
+
+
+def test_windowed_plan_replay_bit_identical(grid24):
+    """Same seed, same windowed plan, same guarded run: fault log AND
+    recovered factor replay bit-identically."""
+    rng = np.random.default_rng(125)
+    arr = (rng.normal(size=(16, 16)) + 16 * np.eye(16)).astype(np.float32)
+
+    def run(plan):
+        with fault_injection(plan):
+            LU, _ = el.lu(_dist(grid24, arr), nb=4, abft=True)
+        return np.asarray(to_global(LU))
+
+    mk = lambda: FaultPlan(seed=77, faults=[
+        FaultSpec("compute", "bitflip", nelem=2, window=(1, 3))])
+    p1, p2 = mk(), mk()
+    d1, d2 = run(p1), run(p2)
+    assert p1.fired() == 1
+    assert logs_identical(p1, p2)
+    np.testing.assert_array_equal(d1, d2)

@@ -1,7 +1,10 @@
 """Chaos under batching (ISSUE 9): the serve acceptance matrix
 {bitflip, scale, nan} x {redistribute, compute} x {oneshot, persistent},
 fault isolation of batch-mates, and deterministic replay of both fault
-logs and breaker transitions."""
+logs and breaker transitions.  ISSUE 11 grows the matrix a ``qr`` op
+column: the same fault axes against ``qr(..., health=True)`` directly
+(qr has no serve admission path), detection riding the ISSUE-9 health
+parity."""
 import numpy as np
 import pytest
 
@@ -87,14 +90,55 @@ def test_oneshot_compute_isolates_batch_mates(grid24):
 
 
 def test_full_matrix_report_clean(grid24):
-    """The aggregated chaos_report/v1 the CLI gate emits: all 12 cells,
-    zero violations, zero vacuous cells."""
-    report = chaos_matrix(grid24, seed=13, service_kw=_CELL_KW)
+    """The aggregated chaos_report/v1: 12 serve cells, zero violations,
+    zero vacuous cells.  The full 18-cell report with the qr column
+    (ISSUE 11) is what ``perf.serve chaos`` gates in check.sh; tier-1
+    covers each qr cell individually below."""
+    report = chaos_matrix(grid24, seed=13, service_kw=_CELL_KW,
+                          qr_column=False)
     assert report["schema"] == "chaos_report/v1"
     assert len(report["cells"]) == 12
     assert report["ok"] is True
     assert report["violations_total"] == 0
     assert report["vacuous_cells"] == 0
+    assert all(c["op"] in ("lu", "hpd") for c in report["cells"])
+
+
+# ---------------------------------------------------------------------
+# THE QR COLUMN (ISSUE 11) -- qr(..., health=True) under injection,
+# detection via the ISSUE-9 health parity.
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("kind", ["bitflip", "scale", "nan"])
+@pytest.mark.parametrize("target", ["redistribute", "compute"])
+def test_qr_column_cell(grid24, target, kind):
+    """Every qr cell fires and violates nothing.  For the kinds health
+    parity guarantees to flag (scale via the growth estimate, nan via
+    the nonfinite scan) a corrupted factor MUST be surfaced; bitflip is
+    recorded honestly -- a shrinking exponent flip sits below the growth
+    threshold, the gap ABFT checksums close for lu/cholesky (qr checksum
+    guarding is a ROADMAP item)."""
+    from elemental_tpu.serve.chaos import QR_DETECTED_KINDS, run_qr_cell
+    cell, plan = run_qr_cell(grid24, kind=kind, target=target)
+    assert cell["fired"] > 0, "fault never landed: the cell is vacuous"
+    assert cell["violations"] == []
+    assert cell["op"] == "qr"
+    if kind in QR_DETECTED_KINDS:
+        assert cell["verdict"] in ("absorbed", "surfaced")
+        if cell["verdict"] == "surfaced":
+            assert cell["health_flags"]      # structured, never silent
+    else:
+        assert cell["verdict"] in ("absorbed", "surfaced", "undetected")
+
+
+def test_qr_column_replay_bit_identical(grid24):
+    """The qr cell is seeded end to end: replaying it reproduces the
+    SAME verdict and a bit-identical fault log."""
+    from elemental_tpu.serve.chaos import run_qr_cell
+    c1, p1 = run_qr_cell(grid24, kind="scale", target="redistribute")
+    c2, p2 = run_qr_cell(grid24, kind="scale", target="redistribute")
+    assert c1 == c2
+    assert logs_identical(p1, p2)
 
 
 # ---------------------------------------------------------------------

@@ -159,7 +159,8 @@ def test_open_breaker_routes_queued_to_escalation(grid24, fake_clock):
     gd = done[good]
     assert gd["status"] == "ok"
     assert gd["path"] == "escalated"             # fastpath was bypassed
-    assert gd["rung"] in ("quant", "fast", "refine", "fp32", "classic")
+    assert gd["rung"] in ("quant", "fast", "refine", "abft", "fp32",
+                          "classic")
 
 
 def test_pressure_and_gauges(grid24):
@@ -191,3 +192,50 @@ def test_fifo_across_buckets(grid24, fake_clock):
     # the lu request waited longer than the hpd one
     assert done[a]["latency_s"] > done[b]["latency_s"]
     assert done[a]["status"] == done[b]["status"] == "ok"
+
+
+# ---------------------------------------------------------------------
+# SATELLITE (ISSUE 11): graceful shutdown -- zero lost requests
+# ---------------------------------------------------------------------
+
+def test_shutdown_drain_completes_everything(grid24):
+    """shutdown(drain=True): every queued request COMPLETES through the
+    normal path; nothing is lost, and new submits are rejected."""
+    rng = np.random.default_rng(29)
+    svc = SolverService(grid24)
+    work = _mixed_workload(rng, count=4)
+    ids = [svc.submit(op, A, B) for op, A, B in work]
+    done = svc.shutdown(drain=True)
+    # zero lost: every accepted id is settled, all executed ok
+    assert set(done) == set(ids)
+    assert all(done[i]["status"] == "ok" for i in ids)
+    assert svc.queue_depth() == 0
+    # post-shutdown submissions get the structured reject
+    rej = svc.submit("lu", diag_dom(rng, 8), rng.normal(size=(8, 1)))
+    assert isinstance(rej, dict)
+    assert rej["schema"] == "serve_reject/v1"
+    assert rej["reason"] == "shutdown"
+
+
+def test_shutdown_flush_rejects_queued(grid24):
+    """shutdown(drain=False): queued requests are NOT executed but each
+    gets a structured serve_reject/v1 (reason='shutdown') carrying its
+    id -- zero silent drops, pinned against the accepted-id set."""
+    rng = np.random.default_rng(30)
+    svc = SolverService(grid24)
+    work = _mixed_workload(rng, count=5)
+    ids = [svc.submit(op, A, B) for op, A, B in work]
+    with _metrics.scoped() as reg:
+        done = svc.shutdown(drain=False)
+        assert reg.counter_value("serve_rejects",
+                                 reason="shutdown") == len(ids)
+    assert set(done) == set(ids)
+    for rid in ids:
+        doc = svc.results[rid]
+        assert doc["schema"] == "serve_reject/v1"
+        assert doc["reason"] == "shutdown"
+        assert doc["id"] == rid
+        assert rid not in svc.solutions          # never executed
+    assert svc.queue_depth() == 0
+    # idempotent: a second shutdown settles nothing new
+    assert svc.shutdown() == {}

@@ -30,8 +30,14 @@
 #                             #   on 1x1 + 2x2, the chaos acceptance
 #                             #   matrix ({bitflip,scale,nan} x
 #                             #   {redistribute,compute} x {oneshot,
-#                             #   persistent}), the bench_serve schema
-#                             #   smoke, and tests/serve
+#                             #   persistent} + the qr op column), the
+#                             #   bench_serve schema smoke, and tests/serve
+#   tools/check.sh abft       # ABFT gate (ISSUE 11): checksum-guarded
+#                             #   lu/cholesky smoke (clean 1x1 + 2x2, zero
+#                             #   violations; injected faults recovered at
+#                             #   panel granularity, recompute count == 1)
+#                             #   + the *_abft comm-plan golden diff +
+#                             #   tests/resilience/test_abft.py
 set -u
 cd "$(dirname "$0")/.."
 
@@ -131,6 +137,19 @@ if [ "$what" = "all" ] || [ "$what" = "resilience" ]; then
     JAX_PLATFORMS=cpu python -m perf.certify smoke || rc=1
     echo "== resilience tier-1 tests (fault injection + health + certify) =="
     python -m pytest tests/resilience -q -m 'not slow' -p no:cacheprovider || rc=1
+fi
+
+if [ "$what" = "all" ] || [ "$what" = "abft" ]; then
+    echo "== abft smoke (guarded lu + cholesky, clean + injected, CPU-safe) =="
+    # clean guarded runs: zero violations, zero recomputes; a windowed
+    # one-shot fault must be detected AT the injected panel and repaired
+    # by exactly ONE panel re-execution
+    JAX_PLATFORMS=cpu python -m perf.abft smoke || rc=1
+    echo "== abft comm-plan goldens (lu_abft / cholesky_abft, 1x1 + 2x2) =="
+    JAX_PLATFORMS=cpu python -m perf.comm_audit diff lu_abft || rc=1
+    JAX_PLATFORMS=cpu python -m perf.comm_audit diff cholesky_abft || rc=1
+    echo "== abft tier-1 tests (detection/recovery acceptance matrix) =="
+    python -m pytest tests/resilience/test_abft.py -q -m 'not slow' -p no:cacheprovider || rc=1
 fi
 
 if [ "$what" = "all" ] || [ "$what" = "serve" ]; then
