@@ -299,6 +299,16 @@ def main():
             _wire_totals["redist_wire_bytes"])
         if _unobserve is not None:
             _unobserve()
+        # chain-vs-direct redistribution GB/s for one representative
+        # move on ALL visible chips (ISSUE 12) -- informational only,
+        # never gated by bench_diff; on a 1-chip host both rates are 0.0
+        # (no wire bytes in the ring model)
+        try:
+            from perf.redist_bench import p2p_gbps
+            obs_doc["redist_p2p_gbps"] = p2p_gbps(el.Grid(jax.devices()))
+        except Exception as e:
+            obs_doc["redist_p2p_gbps"] = {
+                "error": f"{type(e).__name__}: {e}"}
     except Exception as e:                     # never fail the benchmark
         obs_doc["error"] = f"{type(e).__name__}: {e}"
 

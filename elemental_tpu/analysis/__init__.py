@@ -13,7 +13,7 @@ from .jaxpr_walk import (CollectiveEvent, COLLECTIVE_PRIMS, collect_events,
 from .plan import SCHEMA, CommPlan, plan_from_parts, golden_doc, diff_docs
 from .lint import LintFinding, lint_plan
 from .drivers import (DRIVERS, LOOKAHEAD_PAIRS, CALU_PAIRS, COMMQ_PAIRS,
-                      COMMQ_MIN_BYTE_RATIO, DEFAULT_N,
+                      COMMQ_MIN_BYTE_RATIO, DIRECT_PAIRS, DEFAULT_N,
                       DEFAULT_NB, DEFAULT_XOVER, driver_names, trace_driver,
                       trace_callable, storage_shape)
 
@@ -23,7 +23,7 @@ __all__ = [
     "SCHEMA", "CommPlan", "plan_from_parts", "golden_doc", "diff_docs",
     "LintFinding", "lint_plan",
     "DRIVERS", "LOOKAHEAD_PAIRS", "CALU_PAIRS", "COMMQ_PAIRS",
-    "COMMQ_MIN_BYTE_RATIO", "DEFAULT_N", "DEFAULT_NB",
+    "COMMQ_MIN_BYTE_RATIO", "DIRECT_PAIRS", "DEFAULT_N", "DEFAULT_NB",
     "DEFAULT_XOVER", "driver_names", "trace_driver", "trace_callable",
     "storage_shape",
 ]

@@ -69,17 +69,21 @@ class DriverSpec:
     allow_bf16: bool = False
 
 
-def _gemm_spec(alg):
+def _gemm_spec(alg, variant="", redist_path=None):
     def build(grid, n, nb, dtype):
         from ..blas.level3 import gemm
 
         def fn(a, b):
             A = _as_dm(a, grid, n, n)
             B = _as_dm(b, grid, n, n)
-            return gemm(A, B, alg=alg, nb=nb)
+            return gemm(A, B, alg=alg, nb=nb, redist_path=redist_path)
         args = (_mcmr_input(grid, n, n, dtype), _mcmr_input(grid, n, n, dtype))
-        return fn, args, {"alg": alg}
-    return DriverSpec(f"gemm_{alg.lower()}", build)
+        meta = {"alg": alg}
+        if redist_path is not None:
+            meta["redist_path"] = redist_path
+        return fn, args, meta
+    name = f"gemm_{alg.lower()}"
+    return DriverSpec(f"{name}_{variant}" if variant else name, build)
 
 
 def _trsm_spec():
@@ -188,6 +192,15 @@ def _registry() -> dict:
         # so checksum overhead changes are a reviewed diff
         _lu_spec("abft", lookahead=False, crossover=0, abft=True),
         _cholesky_spec("abft", lookahead=False, crossover=0, abft=True),
+        # direct = ISSUE 12's one-shot redistribution twins: the SAME
+        # schedule knobs as the baseline variant plus redist_path=
+        # 'direct', so the golden pair pins the plan-compiler win exactly
+        # -- the chained operand moves (3 hops for the A/B operand
+        # relands, 2 for dot's cyclic ones) collapse into a single
+        # all_to_all on multi-chip grids (DIRECT_PAIRS)
+        _gemm_spec("A", variant="direct", redist_path="direct"),
+        _gemm_spec("B", variant="direct", redist_path="direct"),
+        _gemm_spec("dot", variant="direct", redist_path="direct"),
     ]
     return {s.name: s for s in specs}
 
@@ -222,6 +235,18 @@ COMMQ_PAIRS = (
     ("cholesky_lookahead_commq", "cholesky_lookahead"),
 )
 COMMQ_MIN_BYTE_RATIO = 1.9
+
+#: one-shot redistribution pairs (ISSUE 12): (direct variant, chained
+#: twin) at IDENTICAL schedule knobs.  The golden tests pin, per pair on
+#: the 2x2 grid: STRICTLY FEWER total collective rounds for the direct
+#: variant (the multi-hop operand relands collapse into one all_to_all);
+#: on 1x1 every plan is 'local', so the direct variant issues no
+#: collectives at all (<= the chain's degenerate 1-participant rounds).
+DIRECT_PAIRS = (
+    ("gemm_a_direct", "gemm_a"),
+    ("gemm_b_direct", "gemm_b"),
+    ("gemm_dot_direct", "gemm_dot"),
+)
 
 
 def driver_names() -> list:

@@ -110,6 +110,12 @@ def _axis_names(params: dict):
 
 
 def _axis_size(axes, axis_env: dict, params: dict) -> int:
+    groups = params.get("axis_index_groups")
+    if groups:
+        # grouped collective (all_gather/all_to_all/psum over device
+        # subsets): participants = one group's length, not the full axis
+        # product -- byte estimates must price the subgroup ring
+        return max(1, len(tuple(groups)[0]))
     if "axis_size" in params and params["axis_size"] is not None:
         return int(params["axis_size"])
     size = 1

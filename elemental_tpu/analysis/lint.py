@@ -12,7 +12,11 @@ reports :class:`LintFinding` objects.  Rules:
   EL002 redundant-round-trip    a redistribution whose output is fed
         UNTOUCHED (same object -- provably no intervening compute) into a
         redistribution straight back to the source distribution: the pair
-        is a no-op costing two collective rounds.
+        is a no-op costing two collective rounds.  The finding also
+        carries the one-shot rewrite (ISSUE 12): its ``fix_hint`` quotes
+        the equivalent compiled direct plan -- src->dst, plan kind,
+        round count, ring-model byte estimate vs the chain's -- and
+        ``perf/comm_audit lint --fix-hint`` prints it.
   EL003 loop-invariant-collective   a collective inside a scan/while body
         whose operands derive only from loop constants -- hoistable.
   EL004 f64-promotion           a collective moving float64/complex128
@@ -43,6 +47,7 @@ class LintFinding:
     name: str          # short rule slug
     message: str       # human-readable, names the offending site
     severity: str = "warning"
+    fix_hint: str = "" # concrete rewrite suggestion (lint --fix-hint)
 
     def __str__(self):
         return f"{self.rule} [{self.name}] {self.message}"
@@ -86,6 +91,29 @@ def rule_fuse_adjacent_gathers(plan, redist_log) -> list:
     return out
 
 
+def _direct_rewrite_hint(rec) -> str:
+    """The one-shot rewrite of one chained leg (ISSUE 12): compile the
+    src->dst direct plan and quote rounds/bytes next to the chain's."""
+    gs = tuple(rec.grid_shape or ())
+    if len(gs) != 2:
+        return ""
+    import numpy as np
+    from ..redist.plan import compile_plan
+    from ..redist.engine import chain_cost
+    plan = compile_plan(rec.src, rec.dst, rec.gshape, gs)
+    if plan is None:
+        return ""
+    z = np.dtype(rec.dtype).itemsize
+    rounds_c, bytes_c = chain_cost(rec.src, rec.dst, rec.gshape, gs, z)
+    return (f"if the {rec.dst[0].value}/{rec.dst[1].value} form is "
+            f"actually consumed, route it as redistribute(..., "
+            f"path='direct'): one-shot '{plan.kind}' plan for "
+            f"{rec.label} at {rec.gshape} on {gs[0]}x{gs[1]} = "
+            f"{plan.rounds} round(s) / ~{plan.wire_bytes(z)} B vs the "
+            f"chain's {rounds_c} round(s) / ~{bytes_c} B; otherwise "
+            f"delete both legs")
+
+
 def rule_redundant_round_trip(plan, redist_log) -> list:
     """EL002: A->X then X->A on the untouched intermediate."""
     out = []
@@ -104,7 +132,8 @@ def rule_redundant_round_trip(plan, redist_log) -> list:
                 "EL002", "redundant-round-trip",
                 f"{prev.label} then {r.label} on the SAME untouched "
                 f"{r.gshape} operand: the round trip is a no-op costing "
-                f"two redistribution rounds"))
+                f"two redistribution rounds",
+                fix_hint=_direct_rewrite_hint(prev)))
     return out
 
 

@@ -87,7 +87,8 @@ def _mask_triangle(C: DistMatrix, uplo: str, strict: bool = False):
 def gemm(A: DistMatrix, B: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None = None,
          orient_a: str = "N", orient_b: str = "N", alg: str = "auto",
          nb: int | str | None = None, precision=None,
-         comm_precision: str | None = None) -> DistMatrix:
+         comm_precision: str | None = None,
+         redist_path: str | None = None) -> DistMatrix:
     """C := alpha op(A) op(B) + beta C on [MC,MR] (SUMMA).
 
     ``alg``: 'auto' routes through the tuning subsystem (measured-cache
@@ -104,6 +105,13 @@ def gemm(A: DistMatrix, B: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None
     precision): narrow encode -> collective -> decode, 2x fewer bytes on
     the wire.  Opt-in; ``None`` (default) is bit-identical.
 
+    ``redist_path`` (``None`` | ``'chain'`` | ``'direct'`` | ``'auto'``,
+    ISSUE 12) selects the route of the per-panel operand redistributions:
+    ``'direct'`` replaces the factored multi-hop chains with the one-shot
+    compiled plan (``redist.plan``), ``'auto'`` asks the tuner (knob) and
+    falls back to the per-call ring-model arbitration.  ``None`` (default)
+    keeps the bit-identical chained engine.
+
     Tiled ``BlockMatrix`` operands are accepted via read-proxy conversion
     (``DistMatrixReadProxy``): they re-lay out to [MC,MR] on entry; the
     result converts back to tiled when every input was tiled.
@@ -117,7 +125,7 @@ def gemm(A: DistMatrix, B: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None
         C = as_elemental(C)
     if ret_tiled:
         out = gemm(A, B, alpha, beta, C, orient_a, orient_b, alg, nb,
-                   precision, comm_precision)
+                   precision, comm_precision, redist_path)
         return block_from_cyclic(out)
     A = _orient(A, orient_a)
     B = _orient(B, orient_b)
@@ -137,23 +145,26 @@ def gemm(A: DistMatrix, B: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None
         if C.gshape != (m, n):
             raise ValueError(f"C shape {C.gshape} != ({m},{n})")
 
-    if alg == "auto" or isinstance(nb, str) or comm_precision == "auto":
+    if alg == "auto" or isinstance(nb, str) or comm_precision == "auto" \
+            or redist_path == "auto":
         kn = _resolve_auto("gemm", (m, k, n), C.dtype, A.grid,
-                           alg=alg, nb=nb, comm_precision=comm_precision)
+                           alg=alg, nb=nb, comm_precision=comm_precision,
+                           redist_path=redist_path)
         alg, nb, comm_precision = kn["alg"], kn["nb"], kn["comm_precision"]
+        redist_path = kn.get("redist_path")
     from ..redist.quantize import check_comm_precision
     check_comm_precision(comm_precision)
-    cp = comm_precision
+    cp, rp = comm_precision, redist_path
     tm = _phase_hook("gemm", alg=alg)
     tm.start()
     if alg == "C":
-        return _summa_c(alpha, A, B, beta, C, nb, precision, tm, cp)
+        return _summa_c(alpha, A, B, beta, C, nb, precision, tm, cp, rp)
     if alg == "A":
-        return _summa_a(alpha, A, B, beta, C, nb, precision, tm, cp)
+        return _summa_a(alpha, A, B, beta, C, nb, precision, tm, cp, rp)
     if alg == "B":
-        return _summa_b(alpha, A, B, beta, C, nb, precision, tm, cp)
+        return _summa_b(alpha, A, B, beta, C, nb, precision, tm, cp, rp)
     if alg == "dot":
-        return _summa_dot(alpha, A, B, beta, C, precision, tm, cp)
+        return _summa_dot(alpha, A, B, beta, C, precision, tm, cp, rp)
     if alg == "gspmd":
         # one-shot: re-land B's k-rows on A's k-col cyclic order ([MR,STAR]),
         # then a single storage matmul -- GSPMD inserts the psum over mr.
@@ -167,7 +178,8 @@ def gemm(A: DistMatrix, B: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None
     raise ValueError(f"unknown gemm alg {alg!r}")
 
 
-def _summa_c(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK, cp=None):
+def _summa_c(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK, cp=None,
+             rp=None):
     """Stationary-C (``gemm::SUMMA_NNC``): per k-panel, A1 -> [MC,STAR]
     (AllGather over mr), B1 -> [STAR,MR] (AllGather over mc), local MXU
     product accumulates into C's storage."""
@@ -179,15 +191,16 @@ def _summa_c(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK, cp=None):
     for i, s in enumerate(range(0, k, kb)):
         e = min(s + kb, k)
         A1 = redistribute(view(A, cols=(s, e)), MC, STAR,
-                          comm_precision=cp)
+                          comm_precision=cp, path=rp)
         B1 = redistribute(view(B, rows=(s, e)), STAR, MR,
-                          comm_precision=cp)
+                          comm_precision=cp, path=rp)
         acc = acc + alpha * jnp.matmul(A1.local, B1.local, precision=precision)
         tm.tick("panel", i, acc)
     return C.with_local(_safe_astype(acc, C.dtype))
 
 
-def _summa_a(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK, cp=None):
+def _summa_a(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK, cp=None,
+             rp=None):
     """Stationary-A (``gemm::SUMMA_NNA``): per C column panel, B1 ->
     [MR,STAR]; the k-contraction is sharded over mr on both operands, so the
     storage matmul lowers to local product + psum over mr -> [MC,STAR]
@@ -200,7 +213,7 @@ def _summa_a(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK, cp=None):
     for i, s in enumerate(range(0, n, jb)):
         e = min(s + jb, n)
         B1 = redistribute(view(B, cols=(s, e)), MR, STAR,
-                          comm_precision=cp)
+                          comm_precision=cp, path=rp)
         d = jnp.matmul(A.local, B1.local, precision=precision)   # [MC,STAR] storage
         D1 = DistMatrix(d, (m, e - s), MC, STAR, 0, 0, A.grid)
         panel = redistribute(D1, MC, MR)
@@ -211,7 +224,8 @@ def _summa_a(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK, cp=None):
     return out
 
 
-def _summa_b(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK, cp=None):
+def _summa_b(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK, cp=None,
+             rp=None):
     """Stationary-B: per C row panel, A1^T -> [MC,STAR] (so the k-contraction
     is sharded over mc on both operands); local product + psum over mc ->
     [STAR,MR] partial panel, filtered onto [MC,MR]."""
@@ -223,7 +237,7 @@ def _summa_b(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK, cp=None):
     for i, s in enumerate(range(0, m, ib)):
         e = min(s + ib, m)
         A1T = redistribute(transpose_dist(view(A, rows=(s, e))), MC, STAR,
-                           comm_precision=cp)
+                           comm_precision=cp, path=rp)
         d = jnp.matmul(A1T.local.T, B.local, precision=precision)  # [STAR,MR] storage
         D1 = DistMatrix(d, (e - s, n), STAR, MR, 0, 0, A.grid)
         panel = redistribute(D1, MC, MR)
@@ -234,7 +248,8 @@ def _summa_b(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK, cp=None):
     return out
 
 
-def _summa_dot(alpha, A, B, beta, C, precision, tm=_NULL_HOOK, cp=None):
+def _summa_dot(alpha, A, B, beta, C, precision, tm=_NULL_HOOK, cp=None,
+               rp=None):
     """SUMMA-Dot (``gemm::SUMMA_NNDot``, the small-C case): shard the
     inner dimension 1-D cyclic on BOTH operands ([STAR,VC] x [VC,STAR] --
     the same cyclic permutation on each side, so the storage matmul
@@ -249,8 +264,8 @@ def _summa_dot(alpha, A, B, beta, C, precision, tm=_NULL_HOOK, cp=None):
     if A.grid.size == 1:
         d = jnp.matmul(A.local, B.local, precision=precision)
     else:
-        Avc = redistribute(A, STAR, VC, comm_precision=cp)
-        Bvc = redistribute(B, VC, STAR, comm_precision=cp)
+        Avc = redistribute(A, STAR, VC, comm_precision=cp, path=rp)
+        Bvc = redistribute(B, VC, STAR, comm_precision=cp, path=rp)
         dl = jnp.matmul(Avc.local, Bvc.local, precision=precision)
         D = DistMatrix(dl, (m, n), STAR, STAR, 0, 0, A.grid)
         d = redistribute(D, MC, MR).local

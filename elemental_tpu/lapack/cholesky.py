@@ -247,7 +247,8 @@ def _local_cholesky(A: DistMatrix, nb: int | None, precision,
 def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
              precision=None, lookahead: bool | str = True,
              crossover: int | str | None = None,
-             comm_precision: str | None = None, timer=None,
+             comm_precision: str | None = None,
+             redist_path: str | None = None, timer=None,
              health=None, abft=None) -> DistMatrix:
     """Cholesky factor of an HPD [MC,MR] matrix; reads only the ``uplo``
     triangle.  Returns L (A = L L^H) for 'L', U (A = U^H U) for 'U'.
@@ -269,10 +270,19 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
     ~1e-2..1e-3 relative level -- pair with
     ``resilience.certified_solve('hpd', ...)`` for certified answers.
 
-    Any of ``nb`` / ``lookahead`` / ``crossover`` / ``comm_precision``
-    may be ``'auto'``: the tuning subsystem resolves them per (shape,
-    dtype, grid, backend) -- measured-cache winner first, analytic cost
-    model cold (explicit values always win; see ``elemental_tpu/tune``).
+    ``redist_path`` (``None`` | ``'chain'`` | ``'direct'`` | ``'auto'``)
+    selects the redistribution ROUTE of the same sites: ``'direct'``
+    compiles each dist change into a one-shot collective plan
+    (``redist.plan``), ``'auto'`` arbitrates per move via the engine's
+    chain-vs-plan cost mirror, and ``None``/``'chain'`` keep the factored
+    multi-hop chain (bit-identical baseline).  Both routes move the same
+    values, so the factor is unchanged up to collective reduction order.
+
+    Any of ``nb`` / ``lookahead`` / ``crossover`` / ``comm_precision`` /
+    ``redist_path`` may be ``'auto'``: the tuning subsystem resolves them
+    per (shape, dtype, grid, backend) -- measured-cache winner first,
+    analytic cost model cold (explicit values always win; see
+    ``elemental_tpu/tune``).
 
     ``health`` opts into the resilience guards (NaN/Inf scans, growth
     estimate, non-positive/near-zero diagonal detection on the ``diag``
@@ -289,23 +299,26 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
     """
     _check_mcmr(A)
     if any(isinstance(v, str) for v in (nb, lookahead, crossover)) \
-            or comm_precision == "auto":
+            or comm_precision == "auto" or redist_path == "auto":
         from ..tune.policy import resolve_knobs
         kn = resolve_knobs("cholesky", gshape=A.gshape, dtype=A.dtype,
                            grid=A.grid, knobs={"nb": nb, "lookahead": lookahead,
                                                "crossover": crossover,
-                                               "comm_precision": comm_precision})
+                                               "comm_precision": comm_precision,
+                                               "redist_path": redist_path})
         nb, lookahead, crossover = kn["nb"], kn["lookahead"], kn["crossover"]
         comm_precision = kn["comm_precision"]
+        redist_path = kn["redist_path"]
     check_comm_precision(comm_precision)
+    rp = redist_path
     if uplo.upper().startswith("U"):
         # U = (lower factor of A^H-as-lower)^H; A hermitian so the data of
         # the upper triangle, conj-transposed, is the lower triangle.
         Alow = redistribute(transpose_dist(A, conj=True), MC, MR)
         L = cholesky(Alow, "L", nb=nb, precision=precision,
                      lookahead=lookahead, crossover=crossover,
-                     comm_precision=comm_precision, timer=timer,
-                     health=health, abft=abft)
+                     comm_precision=comm_precision, redist_path=redist_path,
+                     timer=timer, health=health, abft=abft)
         return redistribute(transpose_dist(L, conj=True), MC, MR)
     if abft:
         from ..resilience.abft import abft_cholesky
@@ -337,13 +350,14 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
         # prologue: factor diag block 0 + solve panel 0 from the input
         e0 = min(ib, m)
         A11 = redistribute(view(L, rows=(0, e0), cols=(0, e0)), STAR, STAR,
-                           comm_precision=comm_precision)
+                           comm_precision=comm_precision, path=rp)
         L11, Li11 = _potrf_inv(A11.local, precision)
         tm.tick("diag", 0, L11)
         L21_vc = None
         if e0 < m:
             A21_vc = redistribute(view(L, rows=(e0, m), cols=(0, e0)),
-                                  VC, STAR, comm_precision=comm_precision)
+                                  VC, STAR, comm_precision=comm_precision,
+                                  path=rp)
             x21 = jnp.matmul(A21_vc.local, jnp.conj(Li11).T,
                              precision=_hi(precision)).astype(L.dtype)
             L21_vc = DistMatrix(x21, (m - e0, e0), VC, STAR, 0, 0, g)
@@ -355,7 +369,8 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
             L11, Li11, L21_vc = nxt
         else:
             A11 = redistribute(view(L, rows=(s, e), cols=(s, e)),
-                               STAR, STAR, comm_precision=comm_precision)
+                               STAR, STAR, comm_precision=comm_precision,
+                               path=rp)
             # replicated diagonal-block factor + inverse: every device runs
             # the same deterministic _potrf_inv, so the panel Trsm below is
             # a matmul
@@ -367,7 +382,8 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
             break
         if not lookahead:
             A21_vc = redistribute(view(L, rows=(e, m), cols=(s, e)),
-                                  VC, STAR, comm_precision=comm_precision)
+                                  VC, STAR, comm_precision=comm_precision,
+                                  path=rp)
             x21 = jnp.matmul(A21_vc.local, jnp.conj(Li11).T,
                              precision=_hi(precision)).astype(L.dtype)  # A21 L11^{-H}
             L21_vc = DistMatrix(x21, (m - e, e - s), VC, STAR, 0, 0, g)
@@ -400,14 +416,15 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
                 # off the critical path of the wide remainder update
                 A11n = redistribute(view(stripD, rows=(0, e2 - e),
                                          cols=(0, e2 - e)), STAR, STAR,
-                                    comm_precision=comm_precision)
+                                    comm_precision=comm_precision, path=rp)
                 L11n, Li11n = _potrf_inv(A11n.local, precision)
                 tm.tick("diag", k + 1, L11n)
                 L21n_vc = None
                 if e2 < m:
                     A21n = redistribute(view(stripD, rows=(e2 - e, m - e),
                                              cols=(0, e2 - e)), VC, STAR,
-                                        comm_precision=comm_precision)
+                                        comm_precision=comm_precision,
+                                        path=rp)
                     x21n = jnp.matmul(A21n.local, jnp.conj(Li11n).T,
                                       precision=_hi(precision)).astype(L.dtype)
                     L21n_vc = DistMatrix(x21n, (m - e2, e2 - e), VC, STAR,
@@ -439,7 +456,7 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
             # into a single round trip
             Atail = redistribute(view(L, rows=(e, m), cols=(e, m)),
                                  STAR, STAR,
-                                 comm_precision=comm_precision)
+                                 comm_precision=comm_precision, path=rp)
             lt = _local_chol_array(Atail.local, m - e, ib, precision,
                                    lookahead=lookahead)
             Lt_ss = DistMatrix(lt, (m - e, m - e), STAR, STAR, 0, 0, g)

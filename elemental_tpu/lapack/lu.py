@@ -592,8 +592,8 @@ _CROSSOVER = 4096
 def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
        update_precision=None, lookahead: bool | str = True,
        crossover: int | str | None = None, panel: str = "classic",
-       comm_precision: str | None = None, timer=None, health=None,
-       abft=None):
+       comm_precision: str | None = None, redist_path: str | None = None,
+       timer=None, health=None, abft=None):
     """Blocked right-looking LU with partial pivoting and look-ahead.
 
     Returns (LU, perm): LU holds unit-lower L below the diagonal and U on
@@ -641,11 +641,19 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
     (int8 similar; see README "Quantized collectives") -- pair with
     ``resilience.certified_solve`` for certified answers.
 
+    ``redist_path`` (``None`` | ``'chain'`` | ``'direct'`` | ``'auto'``)
+    selects the redistribution ROUTE of the same bulk moves: ``'direct'``
+    compiles each dist change into a one-shot collective plan
+    (``redist.plan``), ``'auto'`` arbitrates per move via the engine's
+    chain-vs-plan cost mirror, ``None``/``'chain'`` keep the factored
+    multi-hop chain (bit-identical baseline, pinned by the comm-plan
+    goldens).
+
     ``nb`` / ``lookahead`` / ``crossover`` / ``panel`` /
-    ``comm_precision`` accept ``'auto'``: the tuning subsystem
-    (``elemental_tpu/tune``) resolves them per (shape, dtype, grid,
-    backend) -- measured-cache winner first, analytic cost model cold;
-    explicit values always win.  ``panel='auto'`` picks calu on
+    ``comm_precision`` / ``redist_path`` accept ``'auto'``: the tuning
+    subsystem (``elemental_tpu/tune``) resolves them per (shape, dtype,
+    grid, backend) -- measured-cache winner first, analytic cost model
+    cold; explicit values always win.  ``panel='auto'`` picks calu on
     multi-row grids and classic on single-row ones (the pivot latency
     term of the cost model).
 
@@ -673,15 +681,19 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
     before -- pinned by the comm-plan goldens."""
     _check_mcmr(A)
     if any(isinstance(v, str) for v in (nb, lookahead, crossover)) \
-            or panel == "auto" or comm_precision == "auto":
+            or panel == "auto" or comm_precision == "auto" \
+            or redist_path == "auto":
         from ..tune.policy import resolve_knobs
         kn = resolve_knobs("lu", gshape=A.gshape, dtype=A.dtype, grid=A.grid,
                            knobs={"nb": nb, "lookahead": lookahead,
                                   "crossover": crossover, "panel": panel,
-                                  "comm_precision": comm_precision})
+                                  "comm_precision": comm_precision,
+                                  "redist_path": redist_path})
         nb, lookahead, crossover = kn["nb"], kn["lookahead"], kn["crossover"]
         panel, comm_precision = kn["panel"], kn["comm_precision"]
+        redist_path = kn["redist_path"]
     check_comm_precision(comm_precision)
+    rp = redist_path
     if abft:
         from ..resilience.abft import abft_lu
         return abft_lu(A, nb=nb, precision=precision,
@@ -740,7 +752,8 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
     if lookahead:
         e0_up = col_up(min(ib, kend))
         panel0 = redistribute(view(A, rows=(0, m), cols=(0, e0_up)),
-                              STAR, STAR, comm_precision=comm_precision)
+                              STAR, STAR, comm_precision=comm_precision,
+                              path=rp)
         nxt = factor_panel(panel0.local[:, :min(ib, kend)], min(ib, kend), 0)
         tm.tick("panel", 0, nxt)
     for k, s in enumerate(range(0, kend, ib)):
@@ -757,7 +770,7 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
         else:
             panel = redistribute(view(A, rows=(s, m), cols=(s, e_up)),
                                  STAR, STAR,
-                                 comm_precision=comm_precision)
+                                 comm_precision=comm_precision, path=rp)
             Pf, pperm = factor_panel(panel.local[:, :nbw], nbw, k)
             tm.tick("panel", k, Pf, pperm)
         perm = perm.at[s:].set(jnp.take(perm[s:], pperm, axis=0))
@@ -792,12 +805,13 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
                 "bf16" if comm_precision and quantizable(A.dtype) else None)
         else:
             A1n = redistribute(view(A, rows=(s, e), cols=(s, n)),
-                               STAR, VR, comm_precision=comm_precision)
+                               STAR, VR, comm_precision=comm_precision,
+                               path=rp)
             u1n = jnp.matmul(Li11, A1n.local, precision=_hi(precision)
                              ).astype(Pf.dtype)
             U1n = DistMatrix(u1n, (nbw, n - s), STAR, VR, 0, 0, g)
             U1n_mr = redistribute(U1n, STAR, MR,
-                                  comm_precision=comm_precision)
+                                  comm_precision=comm_precision, path=rp)
         tm.tick("solve", k, U1n_mr)
         if not lookahead or e >= kend:
             A = _update_cols_ge(A, redistribute(U1n_mr, MC, MR), (s, e),
@@ -813,7 +827,7 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
                 tm.tick("update", k, A)
             if tail:
                 A, perm = _lu_tail(A, perm, e, ib, precision, upd,
-                                   lookahead, tm, k, comm_precision)
+                                   lookahead, tm, k, comm_precision, rp)
                 break
             continue
         # look-ahead: split the trailing update at the next panel boundary.
@@ -834,7 +848,7 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
             # already (m-e, e2_up-e) from the view metadata); skipped when
             # the tail finish below refactors the whole trailing block
             strip_ss = redistribute(stripD, STAR, STAR,
-                                    comm_precision=comm_precision)
+                                    comm_precision=comm_precision, path=rp)
             nxt = factor_panel(strip_ss.local[:, :e2 - e], e2 - e, k + 1)
             tm.tick("panel", k + 1, nxt)
         # (b) wide remainder update, cols >= e2_up
@@ -855,7 +869,7 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
         tm.tick("update", k, A)
         if tail:
             A, perm = _lu_tail(A, perm, e, ib, precision, upd, lookahead,
-                               tm, k, comm_precision)
+                               tm, k, comm_precision, rp)
             break
     if hm is not None:
         hm.report()
@@ -863,7 +877,8 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
 
 
 def _lu_tail(A: DistMatrix, perm, e: int, ib: int, precision, upd,
-             lookahead: bool, tm, k: int, comm_precision=None):
+             lookahead: bool, tm, k: int, comm_precision=None,
+             redist_path=None):
     """Crossover-to-local finish of the (fully updated) trailing block.
 
     One [STAR,STAR] gather of rows/cols >= e, a replicated run of the
@@ -875,7 +890,7 @@ def _lu_tail(A: DistMatrix, perm, e: int, ib: int, precision, upd,
     m, n = A.gshape
     g = A.grid
     Atail = redistribute(view(A, rows=(e, m), cols=(e, n)), STAR, STAR,
-                         comm_precision=comm_precision)
+                         comm_precision=comm_precision, path=redist_path)
     at, pt = _local_lu_array(Atail.local, m - e, n - e, ib, precision,
                              upd, lookahead)
     # the tail's composed row permutation applies to the WHOLE row range

@@ -89,7 +89,7 @@ class InstantEvent:
 class CommEvent:
     """One public redistribute/panel_spread entry observed at runtime."""
     t: float
-    kind: str                    # "redistribute" | "panel_spread"
+    kind: str                    # "redistribute" | "panel_spread" | "row_permute"
     label: str                   # "[MC,MR]->[STAR,STAR]" | "panel_spread"
     gshape: tuple
     dtype: str
@@ -101,6 +101,15 @@ class CommEvent:
     #: is bfloat16/int8 and wire_bytes shows the 2-4x drop
     wire_dtype: str = ""
     wire_bytes: int = 0
+    #: route the engine resolved (ISSUE 12): "chain" | "direct" |
+    #: "storage" (row-permute fast path); "" for pre-path entries
+    path: str = ""
+    #: collective rounds of the resolved route (-1 = engine didn't price)
+    rounds: int = -1
+    #: the engine's exact ring-model pricing of the resolved route at the
+    #: wire dtype (-1 = not computed) -- finer than the coarse ``bytes``/
+    #: ``wire_bytes`` estimate, and the per-round byte record of the path
+    engine_wire_bytes: int = -1
 
 
 def ring_bytes(gshape, dtype, grid_shape) -> int:
@@ -258,7 +267,10 @@ class Tracer:
             t=self.clock(), kind=rec.kind, label=rec.label,
             gshape=tuple(rec.gshape), dtype=rec.dtype, bytes=nbytes,
             span=self._stack[-1].name if self._stack else None,
-            driver=self._cur_driver, wire_dtype=wire, wire_bytes=wbytes))
+            driver=self._cur_driver, wire_dtype=wire, wire_bytes=wbytes,
+            path=str(getattr(rec, "path", "") or ""),
+            rounds=int(getattr(rec, "rounds", -1)),
+            engine_wire_bytes=int(getattr(rec, "wire_bytes", -1))))
         if self._metrics:
             _metrics.inc("redist_calls", label=rec.label)
             _metrics.inc("redist_bytes", nbytes, label=rec.label)
@@ -285,9 +297,14 @@ class Tracer:
     def redist_counts(self) -> dict:
         """{label: count} over the recorded collective events -- the
         runtime twin of a ``comm_plan/v1`` document's ``redistributes``
-        table (tests cross-check the two against the goldens)."""
+        table (tests cross-check the two against the goldens).  Storage
+        -level ``row_permute`` entries are excluded to match: GSPMD plans
+        their motion, so the goldens pin no explicit rounds for them (the
+        byte totals below still count their wire traffic)."""
         out: dict = {}
         for ev in self.comms:
+            if ev.kind == "row_permute":
+                continue
             out[ev.label] = out.get(ev.label, 0) + 1
         return dict(sorted(out.items()))
 

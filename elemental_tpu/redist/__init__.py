@@ -1,0 +1,7 @@
+"""Redistribution subsystem: the chained engine (:mod:`.engine`), the
+one-shot plan compiler (:mod:`.plan`, ISSUE 12), and the wire codecs
+(:mod:`.quantize`).  Only the numpy-level compiler is re-exported here;
+import :mod:`.engine` explicitly for the executing entry points."""
+from .plan import RedistPlan, compile_plan, comm_axes_for
+
+__all__ = ["RedistPlan", "compile_plan", "comm_axes_for"]

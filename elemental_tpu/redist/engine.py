@@ -29,7 +29,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 from collections import Counter
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -42,8 +42,16 @@ from ..core.dist import (
     stride as dist_stride, gather_axes, rank_of, md_slot_of_global,
 )
 from ..core.distmatrix import DistMatrix, _check_pair
+from .plan import compile_plan
 from .quantize import (QUANT_TILE, check_comm_precision, q8_pack, q8_unpack,
                        quantizable)
+
+#: legal values of :func:`redistribute`'s ``path`` argument.  ``None`` and
+#: ``'chain'`` are the factored multi-hop route (bit-identical to the
+#: pre-ISSUE-12 engine); ``'direct'`` executes the one-shot compiled plan
+#: (:mod:`.plan`) where one exists, falling back to the chain otherwise;
+#: ``'auto'`` arbitrates per call with the ring-model cost below.
+REDIST_PATHS = (None, "chain", "direct", "auto")
 
 
 #: Trace-time instrumentation: public-entry call counts, keyed by
@@ -101,13 +109,26 @@ class RedistRecord:
     #: dtype actually moved on the wire (== ``dtype`` unless the entry ran
     #: under a ``comm_precision`` mode -- "bfloat16" / "int8" then)
     wire_dtype: str = ""
+    #: route the engine resolved for this entry: "chain" (factored hops,
+    #: the default), "direct" (one-shot compiled plan), or "storage" (the
+    #: row-permute fast path, whose cross-device motion GSPMD plans)
+    path: str = "chain"
+    #: collective rounds the resolved route issues (-1 = not computed)
+    rounds: int = -1
+    #: ring-model bytes received per device by the resolved route
+    #: (-1 = not computed); with ``rounds`` this is the "per-round wire
+    #: bytes" record of the chosen path
+    wire_bytes: int = -1
     # live references keep the ids above unambiguous (no id reuse after GC)
     refs: tuple = dataclasses.field(default=(), repr=False, compare=False)
 
     @property
     def label(self) -> str:
-        if self.kind == "panel_spread":
-            return "panel_spread"
+        # non-redistribute kinds ("panel_spread", "row_permute") label as
+        # themselves; dist pairs keep the PATH-INDEPENDENT [src]->[dst]
+        # form so comm-plan goldens aggregate identically on either route
+        if self.kind != "redistribute":
+            return self.kind
         s = f"[{self.src[0].value},{self.src[1].value}]"
         d = f"[{self.dst[0].value},{self.dst[1].value}]"
         return f"{s}->{d}"
@@ -212,16 +233,23 @@ def apply_fault(target: str, outputs: tuple) -> tuple:
 
 
 def _trace_record(kind, src, dst, gshape, dtype, objs_in, objs_out,
-                  grid_shape=(), wire_dtype=None):
+                  grid_shape=(), wire_dtype=None, path="chain", rounds=-1,
+                  wire_bytes=-1, observers_only=False):
+    """Build + publish one RedistRecord.  ``observers_only`` skips the
+    ``redist_trace`` list (used by the row-permute fast path: the obs
+    tracer must see its wire traffic, but the comm-plan goldens aggregate
+    ``redist_trace`` records and GSPMD-planned motion has no explicit
+    collective rounds to pin)."""
     if _REDIST_TRACE is None and not _REDIST_OBSERVERS:
         return
     rec = RedistRecord(
         kind=kind, src=tuple(src), dst=tuple(dst), gshape=tuple(gshape),
         dtype=str(dtype), in_id=id(objs_in),
         out_ids=tuple(id(o) for o in objs_out), grid_shape=tuple(grid_shape),
-        wire_dtype=str(wire_dtype or dtype),
+        wire_dtype=str(wire_dtype or dtype), path=path, rounds=rounds,
+        wire_bytes=wire_bytes,
         refs=(objs_in,) + tuple(objs_out))
-    if _REDIST_TRACE is not None:
+    if _REDIST_TRACE is not None and not observers_only:
         _REDIST_TRACE.append(rec)
     for cb in tuple(_REDIST_OBSERVERS):
         cb(rec)
@@ -715,6 +743,213 @@ def _retag(A: DistMatrix, dim: int, d: Dist, loc) -> DistMatrix:
 
 
 # ---------------------------------------------------------------------
+# one-shot direct path (ISSUE 12 -- the COSTA plan compiler in .plan):
+# static chain-cost mirror, the shard_map executor for a compiled
+# RedistPlan, and the per-call chain-vs-direct arbitration
+# ---------------------------------------------------------------------
+
+def _fused_steps(src, dst, r, c):
+    """Steps of the fused fast paths of :func:`_fused_dispatch`, as
+    (kind, participants, moving-block dist pair) tuples -- None when no
+    fused kernel dispatches (mirrors its conditions exactly)."""
+    if src in ((MC, MR), (MR, MC)) and dst == (STAR, STAR):
+        if r > 1 and c > 1:
+            return [("ag", r * c, src)]
+        return None                         # 1-D grid: generic route
+    fused_v = {((MC, MR), (VC, STAR)), ((VC, STAR), (MC, MR)),
+               ((MR, MC), (VR, STAR)), ((VR, STAR), (MR, MC)),
+               ((MC, MR), (STAR, VR)), ((STAR, VR), (MC, MR)),
+               ((MR, MC), (STAR, VC)), ((STAR, VC), (MR, MC))}
+    if (src, dst) in fused_v:
+        # the fused M<->V kernels a2a over the axis the V dist refines
+        # ALONG: c participants when VC is the V endpoint, r when VR
+        vs = [d for pair in (src, dst) for d in pair if d in (VC, VR)]
+        return [("a2a", c if vs[0] is VC else r, src)]
+    return None
+
+
+def _dim_steps(pair, dim, new, r, c):
+    """Steps of a single-dim change (:func:`_rowdim_change` /
+    :func:`_coldim_change` + the partial ladder), or None (no fast path)."""
+    src_d = pair[dim]
+    p = r * c
+    if src_d is new:
+        return []
+    if src_d is STAR:
+        return [("local", 1, pair)]
+    if new is STAR:
+        S = dist_stride(src_d, r, c)
+        return [("ag", S, pair)] if S > 1 else [("local", 1, pair)]
+    if (src_d, new) in ((VC, MC), (VR, MR)):
+        nb = c if src_d is VC else r
+        return [("ag", nb, pair)] if nb > 1 else [("local", 1, pair)]
+    if (src_d, new) in ((MC, VC), (MR, VR)):
+        return [("local", 1, pair)]
+    if {src_d, new} == {VC, VR}:
+        if p == 1 or r == 1 or c == 1:
+            return [("local", 1, pair)]
+        return [("ppermute", p, pair)]
+    return None
+
+
+def _chain_steps(src, dst, r, c):
+    """Static mirror of :func:`to_dist`'s zero-aligned dispatch: the
+    ordered (kind, participants, block pair) collective steps the chained
+    route runs for ``src -> dst``.  Purely metadata -- nothing traces."""
+    if src == dst:
+        return []
+    steps = _fused_steps(src, dst, r, c)
+    if steps is not None:
+        return steps
+    if src[0] is dst[0]:
+        steps = _dim_steps(src, 1, dst[1], r, c)
+        if steps is not None:
+            return steps
+    if src[1] is dst[1]:
+        steps = _dim_steps(src, 0, dst[0], r, c)
+        if steps is not None:
+            return steps
+    route = _CHAINS.get((src, dst))
+    if route is not None:
+        steps, cur = [], src
+        for hop in route:
+            steps += _chain_steps(cur, hop, r, c)
+            cur = hop
+        return steps
+    # generic fallback: per-dim gathers through [STAR,STAR], local filter
+    steps = []
+    for dim, pair in ((0, src), (1, (STAR, src[1]))):
+        if pair[dim] is MD:
+            steps.append(("ag", r * c, pair))
+        elif dist_stride(pair[dim], r, c) > 1:
+            steps.append(("ag", dist_stride(pair[dim], r, c), pair))
+    return steps
+
+
+@lru_cache(maxsize=None)
+def chain_cost(src, dst, gshape, grid_shape, itemsize):
+    """(collective_rounds, ring-model bytes received per device) of the
+    CHAINED route for a zero-aligned ``src -> dst`` -- the comparison
+    the direct plan is arbitrated against (and the payload of the EL002
+    rewrite hint)."""
+    src, dst = tuple(src), tuple(dst)
+    r, c = grid_shape
+    m, n = gshape
+    if src == dst or r * c == 1:
+        return 0, 0
+    rounds, total = 0, 0
+    for kind, S, pair in _chain_steps(src, dst, r, c):
+        if kind == "local" or S <= 1:
+            continue
+        b = (itemsize * ix.max_local_length(m, dist_stride(pair[0], r, c))
+             * ix.max_local_length(n, dist_stride(pair[1], r, c)))
+        rounds += 1
+        if kind == "ag":
+            total += b * (S - 1)
+        elif kind == "a2a":
+            total += b * (S - 1) // S
+        else:                                  # ppermute
+            total += b
+    return rounds, total
+
+
+def direct_plan_for(A: DistMatrix, cdist: Dist, rdist: Dist,
+                    calign: int = 0, ralign: int = 0):
+    """The compiled one-shot plan for this redistribution, or None when
+    no plan applies (alignment, MD/CIRC, or a no-op)."""
+    if (calign, ralign) != (0, 0) or not _zero_aligned(A):
+        return None
+    return compile_plan(A.dist, (cdist, rdist), A.gshape,
+                        (A.grid.height, A.grid.width))
+
+
+def _machine_terms():
+    """(latency_s, bw_bytes_per_s) for the running backend; safe TPU-ish
+    defaults when the tune subsystem is unavailable."""
+    try:
+        from ..tune.cost_model import machine_for
+        mm = machine_for(jax.default_backend())
+        return mm.latency_s, mm.bw_bytes_per_s
+    except Exception:
+        return 2e-6, 4.5e10
+
+
+def _direct_wins(plan, gshape, itemsize) -> bool:
+    """``path='auto'`` arbitration: alpha-beta (latency x rounds +
+    bytes / bandwidth) comparison of the one-shot plan against the
+    chained route; ties go to the chain (the bit-identical default)."""
+    rounds_c, bytes_c = chain_cost(plan.src, plan.dst, gshape,
+                                   plan.grid_shape, itemsize)
+    if rounds_c == 0:
+        return False
+    lat, bw = _machine_terms()
+    t_direct = lat * plan.rounds + plan.wire_bytes(itemsize) / bw
+    t_chain = lat * rounds_c + bytes_c / bw
+    return t_direct < t_chain
+
+
+def _direct_exec(x, plan, wire, dt):
+    """Execute a compiled RedistPlan inside shard_map: static-map gather
+    -> one collective (or none) -> static-map scatter onto zeros.
+
+    The (p, K, R)/(p, K, C) tables become jaxpr constants; each device
+    selects its row by ``axis_index``.  Sentinel indices (== the local
+    extent) mask to zero on the gather and drop on the scatter, which
+    keeps the padding-is-zero storage invariant without data-dependent
+    shapes.  ``wire='int8'`` block-scale-packs each slot (vmap of the
+    :mod:`.quantize` codec) so the ONE collective moves int8; bf16 is
+    cast by the caller around this function."""
+    r, c = plan.grid_shape
+    dev = lax.axis_index("mc") * c + lax.axis_index("mr")
+    sr = jnp.take(jnp.asarray(plan.send_rows), dev, axis=0)     # (K, R)
+    sc = jnp.take(jnp.asarray(plan.send_cols), dev, axis=0)     # (K, C)
+    lr_s, lc_s = plan.src_local
+    ok = (sr < lr_s)[:, :, None] & (sc < lc_s)[:, None, :]
+    vals = x[jnp.clip(sr, 0, lr_s - 1)[:, :, None],
+             jnp.clip(sc, 0, lc_s - 1)[:, None, :]]
+    vals = jnp.where(ok, vals, 0)                               # (K, R, C)
+    R, C = plan.slot_shape
+    q8 = wire == "int8" and plan.kind != "local"
+    if q8:
+        vals = jax.vmap(lambda s: q8_pack(s, QUANT_TILE))(vals)
+    if plan.kind == "a2a":
+        recv = lax.all_to_all(vals, plan.comm_axes, split_axis=0,
+                              concat_axis=0)
+    elif plan.kind == "ppermute":
+        recv = lax.ppermute(vals, plan.comm_axes, list(plan.perm))
+    else:
+        recv = vals
+    if q8:
+        recv = jax.vmap(lambda s: q8_unpack(s, (R, C), dt, QUANT_TILE))(recv)
+    rr = jnp.take(jnp.asarray(plan.recv_rows), dev, axis=0)
+    rc = jnp.take(jnp.asarray(plan.recv_cols), dev, axis=0)
+    out = jnp.zeros(plan.dst_local, recv.dtype)
+    return out.at[rr[:, :, None], rc[:, None, :]].set(recv, mode="drop")
+
+
+@partial(jax.jit, static_argnums=(1, 2, 3))
+def _redistribute_direct_jit(A: DistMatrix, cdist: Dist, rdist: Dist,
+                             wire=None) -> DistMatrix:
+    plan = compile_plan(A.dist, (cdist, rdist), A.gshape,
+                        (A.grid.height, A.grid.width))
+    out_meta = DistMatrix(None, A.gshape, cdist, rdist, 0, 0, A.grid)
+    dt = A.dtype
+
+    def f(a):
+        x = a.local
+        if wire == "bf16":
+            x = x.astype(jnp.bfloat16)
+        loc = _direct_exec(x, plan, wire, dt)
+        loc = loc.astype(dt)
+        return DistMatrix(loc, A.gshape, cdist, rdist, 0, 0, A.grid)
+
+    return shard_map(
+        f, mesh=A.grid.mesh, in_specs=(A.spec,), out_specs=out_meta.spec,
+        check_vma=False,
+    )(A)
+
+
+# ---------------------------------------------------------------------
 # quantized wire precision (the ``comm_precision`` knob, ISSUE 8 --
 # EQuARX direction, PAPERS.md 2506.17615): encode the payload narrow,
 # run the SAME collective schedule on it, decode on the far side.  The
@@ -952,7 +1187,19 @@ def move_rows(A: DistMatrix, targets, sources, valid) -> DistMatrix:
     gsrc = _storage_row_of(jnp.clip(sources, 0, m - 1), S, lr)
     stor = A.local
     rows = jnp.take(stor, gsrc, axis=0)
-    return A.with_local(stor.at[sidx].set(rows, mode="drop"))
+    out = A.with_local(stor.at[sidx].set(rows, mode="drop"))
+    # observer seam (ISSUE 12): the obs tracer must see this entry's wire
+    # traffic (<= moved rows x local row width, worst case all cross-chip)
+    # even though GSPMD plans the motion -- observers_only keeps it OUT of
+    # the comm-plan golden aggregation, which pins explicit rounds
+    k = int(targets.shape[0])
+    _trace_record("row_permute", A.dist, A.dist, (k, A.gshape[1]),
+                  A.dtype, A.local, (out.local,),
+                  grid_shape=(A.grid.height, A.grid.width),
+                  path="storage", rounds=0,
+                  wire_bytes=k * stor.shape[1] * jnp.dtype(A.dtype).itemsize,
+                  observers_only=True)
+    return out
 
 
 def permute_rows_storage(A: DistMatrix, perm, inverse: bool = False
@@ -971,13 +1218,24 @@ def permute_rows_storage(A: DistMatrix, perm, inverse: bool = False
     m = A.gshape[0]
     S, lr = A.col_stride, A.local_rows
     if S == 1:
-        return A.with_local(jnp.take(A.local, p, axis=0))
-    sr = jnp.arange(S * lr)
-    gi = (sr % lr) * S + sr // lr                  # global row of storage slot
-    src = _storage_row_of(p[jnp.clip(gi, 0, m - 1)], S, lr)
-    out = jnp.take(A.local, src, axis=0)
-    out = jnp.where((gi < m)[:, None], out, 0)     # keep padding zeroed
-    return A.with_local(out)
+        res = A.with_local(jnp.take(A.local, p, axis=0))
+    else:
+        sr = jnp.arange(S * lr)
+        gi = (sr % lr) * S + sr // lr              # global row of storage slot
+        src = _storage_row_of(p[jnp.clip(gi, 0, m - 1)], S, lr)
+        out = jnp.take(A.local, src, axis=0)
+        out = jnp.where((gi < m)[:, None], out, 0)  # keep padding zeroed
+        res = A.with_local(out)
+    # observer seam (ISSUE 12): surface the GSPMD-planned full-permutation
+    # motion to the obs tracer (worst case the whole local block crosses
+    # chips); observers_only keeps it out of the round-pinning goldens
+    _trace_record("row_permute", A.dist, A.dist, A.gshape, A.dtype,
+                  A.local, (res.local,),
+                  grid_shape=(A.grid.height, A.grid.width),
+                  path="storage", rounds=0,
+                  wire_bytes=int(A.local.size) * jnp.dtype(A.dtype).itemsize,
+                  observers_only=True)
+    return res
 
 
 # ---------------------------------------------------------------------
@@ -1057,7 +1315,7 @@ def _scatter_sum_dim(x, dim: int, axis_name: str, S: int, l_out: int):
 
 def redistribute(A: DistMatrix, cdist: Dist, rdist: Dist,
                  calign: int = 0, ralign: int = 0,
-                 comm_precision=None) -> DistMatrix:
+                 comm_precision=None, path=None) -> DistMatrix:
     """B[cdist,rdist] = A, as a standalone (jit-able) op on storage-form
     DistMatrix.  ``Copy(A, B)`` / ``operator=`` of the reference.
 
@@ -1076,12 +1334,49 @@ def redistribute(A: DistMatrix, cdist: Dist, rdist: Dist,
     bit-identical full-precision path; the knob is a no-op on 1x1 grids,
     non-real-float payloads, and replicated sources (pure-local filters).
 
+    ``path`` (see :data:`REDIST_PATHS`, ISSUE 12) selects the route:
+    ``None``/``'chain'`` run the factored multi-hop dispatch (bit-identical
+    to the historical engine); ``'direct'`` executes the ONE-SHOT compiled
+    plan (:mod:`.plan` -- a single all_to_all/ppermute with static
+    gather/scatter index maps) whenever one compiles, falling back to the
+    chain otherwise (alignments, MD/CIRC endpoints); ``'auto'`` compiles
+    the plan and takes it only where the ring-model alpha-beta cost says
+    it beats the chain (ties go to the chain).  On the direct route an
+    ``'int8'`` ``comm_precision`` block-scale-packs every plan slot, so
+    the narrow payload rides ANY pair's single collective -- not just the
+    gather-to-[STAR,STAR] family.
+
     CIRC conversions (root-only storage) run EAGERLY at this edge via the
     global bridges plus cross-device ``device_put`` (copy::Gather /
     copy::Scatter) -- they cannot live inside jit/shard_map."""
     _check_pair(cdist, rdist)
+    if path not in REDIST_PATHS:
+        raise ValueError(f"path must be one of {REDIST_PATHS}, got {path!r}")
     REDIST_COUNTS[(A.dist, (cdist, rdist))] += 1
-    if cdist is CIRC or A.cdist is CIRC:
+    grid_shape = (A.grid.height, A.grid.width)
+    circ = cdist is CIRC or A.cdist is CIRC
+    noop = A.dist == (cdist, rdist) \
+        and (A.calign, A.ralign) == (calign, ralign)
+    plan = None
+    if path in ("direct", "auto") and not circ and not noop:
+        plan = direct_plan_for(A, cdist, rdist, calign, ralign)
+        if plan is not None and path == "auto" and \
+                not _direct_wins(plan, A.gshape, jnp.dtype(A.dtype).itemsize):
+            plan = None
+    if plan is not None:
+        wire = None if plan.kind == "local" \
+            else _wire_mode(A, comm_precision, q8_ok=True)
+        out = _redistribute_direct_jit(A, cdist, rdist, wire)
+        if _FAULT_INJECTOR is not None:
+            out = out.with_local(
+                _FAULT_INJECTOR.apply("redistribute", (out.local,))[0])
+        wire_sz = {"bf16": 2, "int8": 1}.get(wire, jnp.dtype(A.dtype).itemsize)
+        _trace_record("redistribute", A.dist, (cdist, rdist), A.gshape,
+                      A.dtype, A.local, (out.local,), grid_shape=grid_shape,
+                      wire_dtype=_WIRE_DTYPES.get(wire), path="direct",
+                      rounds=plan.rounds, wire_bytes=plan.wire_bytes(wire_sz))
+        return out
+    if circ:
         check_comm_precision(comm_precision)
         wire = None
         out = _redistribute_circ(A, cdist, rdist, calign, ralign)
@@ -1089,8 +1384,6 @@ def redistribute(A: DistMatrix, cdist: Dist, rdist: Dist,
         q8_ok = ((cdist, rdist) == (STAR, STAR)
                  and (calign, ralign) == (0, 0) and _zero_aligned(A)
                  and set(A.dist) <= _Q8_DISTS)
-        noop = A.dist == (cdist, rdist) \
-            and (A.calign, A.ralign) == (calign, ralign)
         wire = None if noop else _wire_mode(A, comm_precision, q8_ok)
         if wire == "int8":
             out = _redistribute_q8_jit(A, QUANT_TILE)
@@ -1099,10 +1392,16 @@ def redistribute(A: DistMatrix, cdist: Dist, rdist: Dist,
     if _FAULT_INJECTOR is not None:
         out = out.with_local(
             _FAULT_INJECTOR.apply("redistribute", (out.local,))[0])
+    rounds = wire_bytes = -1
+    if not circ and not noop and _zero_aligned(A) and (calign, ralign) == (0, 0):
+        wire_sz = {"bf16": 2, "int8": 1}.get(wire, jnp.dtype(A.dtype).itemsize)
+        rounds, wire_bytes = chain_cost(A.dist, (cdist, rdist), A.gshape,
+                                        grid_shape, wire_sz)
     _trace_record("redistribute", A.dist, (cdist, rdist), A.gshape,
                   A.dtype, A.local, (out.local,),
-                  grid_shape=(A.grid.height, A.grid.width),
-                  wire_dtype=_WIRE_DTYPES.get(wire))
+                  grid_shape=grid_shape,
+                  wire_dtype=_WIRE_DTYPES.get(wire), path="chain",
+                  rounds=rounds, wire_bytes=wire_bytes)
     return out
 
 

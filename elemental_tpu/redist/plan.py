@@ -1,0 +1,324 @@
+"""One-shot redistribution plan compiler (ISSUE 12 -- the COSTA direction).
+
+COSTA (arXiv 2106.06601) and "Memory-efficient array redistribution
+through portable collective communication" (arXiv 2112.01075) observe
+that an arbitrary src->dst distribution change factors into exactly one
+collective exchange once the shard intersections are computed statically.
+This module is that computation, engine-independent and numpy-only:
+
+  ``compile_plan(src, dst, gshape, grid_shape) -> RedistPlan | None``
+
+The compiler works per mesh axis.  Each distribution pins some device
+coordinates as a residue function of the global index (MC pins ``mc`` to
+``i % r``; MR pins ``mr``; VC/VR pin both through the 1-D rank; STAR pins
+nothing).  For every entry a receiver needs under the destination pair
+there is a unique *canonical sender*: the device taking the source's
+pinned coordinates and copying the receiver's coordinates on the source's
+free axes.  An axis carries traffic iff the source pins it AND the
+destination's pin is not the identical residue function -- which yields
+three plan kinds:
+
+  * ``'local'``    -- no axis carries traffic: pure gather/scatter on-chip
+                      (e.g. ``[STAR,STAR] -> [MC,MR]``, ``[MC,*] -> [VC,*]``).
+  * ``'ppermute'`` -- every device exchanges its whole slot with exactly
+                      one peer: a wholesale relabeling (e.g. ``VC <-> VR``).
+  * ``'a2a'``      -- one ``lax.all_to_all`` over exactly the
+                      traffic-carrying axes.
+
+Per (sender, receiver) pair the owned-by-src / needed-by-dst index sets
+along each dim are congruence intersections ``i = a (mod S_src)`` and
+``i = b (mod S_dst)`` -- an arithmetic progression of period
+``lcm(S_src, S_dst)`` solved by CRT (or empty, in which case the slot
+ships sentinel padding; the byte estimate is honest about that and the
+chain-vs-direct arbitration lives with the caller/tuner).  The emitted
+index maps are dense ``(p, K, R)``/``(p, K, C)`` int32 tables selected by
+device id inside ``shard_map`` -- see ``engine._direct_exec``.
+
+Restrictions (compile_plan returns None): MD/CIRC endpoints, src == dst,
+and nonzero alignments (gated by the engine caller).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+
+import numpy as np
+
+from ..core import indexing as ix
+from ..core.dist import MC, MR, VC, VR, STAR, MD, CIRC, stride as dist_stride
+
+#: mesh axis names in mesh order; linear device id = mc * c + mr
+MESH_AXES = ("mc", "mr")
+
+#: mesh axes whose device coordinate each dist pins
+_PINS = {MC: ("mc",), MR: ("mr",), VC: ("mc", "mr"), VR: ("mc", "mr"),
+         STAR: ()}
+
+
+def _pin(d, g: int, r: int, c: int) -> dict:
+    """Device coordinates dist ``d`` forces for global index ``g``."""
+    if d is MC:
+        return {"mc": g % r}
+    if d is MR:
+        return {"mr": g % c}
+    if d is VC:
+        q = g % (r * c)
+        return {"mc": q % r, "mr": q // r}
+    if d is VR:
+        q = g % (r * c)
+        return {"mc": q // c, "mr": q % c}
+    return {}
+
+
+def _rank_under(d, mc: int, mr: int, r: int, c: int) -> int:
+    """The residue a device (mc, mr) owns under dist ``d`` (0 for STAR)."""
+    if d is MC:
+        return mc
+    if d is MR:
+        return mr
+    if d is VC:
+        return mc + r * mr
+    if d is VR:
+        return mr + c * mc
+    return 0
+
+
+def _axis_pinner(pair, axis: str):
+    """(dim, dist) of the pair member pinning ``axis``, or None (free)."""
+    for dim, d in enumerate(pair):
+        if axis in _PINS.get(d, ()):
+            return dim, d
+    return None
+
+
+def _lcm(a: int, b: int) -> int:
+    return a // math.gcd(a, b) * b
+
+
+def comm_axes_for(src, dst, r: int, c: int) -> tuple:
+    """Mesh axes that carry traffic for ``src -> dst`` on an r x c grid.
+
+    An axis moves data iff the source pins it and the destination does
+    not pin it with the identical residue function (same dim, same value
+    for every global index over one lcm period).  Size-1 axes never
+    carry traffic.
+    """
+    sizes = {"mc": r, "mr": c}
+    axes = []
+    for axis in MESH_AXES:
+        if sizes[axis] == 1:
+            continue
+        sp = _axis_pinner(src, axis)
+        if sp is None:
+            continue                      # free in src: sender copies q's coord
+        dp = _axis_pinner(dst, axis)
+        if dp is None or dp[0] != sp[0]:
+            axes.append(axis)
+            continue
+        period = _lcm(dist_stride(sp[1], r, c), dist_stride(dp[1], r, c))
+        if any(_pin(sp[1], g, r, c)[axis] != _pin(dp[1], g, r, c)[axis]
+               for g in range(period)):
+            axes.append(axis)
+    return tuple(axes)
+
+
+def _crt(a1: int, n1: int, a2: int, n2: int):
+    """Solve x = a1 (mod n1), x = a2 (mod n2): (x0, lcm) or None (empty)."""
+    g = math.gcd(n1, n2)
+    if (a2 - a1) % g:
+        return None
+    lcm = n1 // g * n2
+    m = n2 // g
+    if m == 1:
+        return a1 % lcm, lcm
+    t = ((a2 - a1) // g * pow(n1 // g, -1, m)) % m
+    return (a1 + n1 * t) % lcm, lcm
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class RedistPlan:
+    """A compiled one-shot redistribution: one collective (or none) plus
+    static pre-gather / post-scatter index maps.
+
+    The maps are dense per-device tables (row 0 = device ``mc*c+mr == 0``)
+    with an out-of-range *sentinel* (== the local extent) marking padding:
+    the gather masks sentinels to zero, the scatter drops them
+    (``mode='drop'``), which preserves the engine's padding-is-zero
+    storage invariant with no data-dependent shapes.
+    """
+    src: tuple                #: (cdist, rdist) source pair
+    dst: tuple                #: (cdist, rdist) destination pair
+    gshape: tuple             #: global (m, n)
+    grid_shape: tuple         #: (r, c)
+    kind: str                 #: 'local' | 'ppermute' | 'a2a'
+    comm_axes: tuple          #: mesh axes the collective runs over
+    perm: tuple               #: ((src_id, dst_id), ...) for 'ppermute'
+    slot_shape: tuple         #: (R, C) of one exchange slot
+    send_rows: np.ndarray     #: (p, K, R) src-local row of slot element
+    send_cols: np.ndarray     #: (p, K, C) src-local col of slot element
+    recv_rows: np.ndarray     #: (p, K, R) dst-local row of slot element
+    recv_cols: np.ndarray     #: (p, K, C) dst-local col of slot element
+    src_local: tuple          #: (lr, lc) of the source block inside shard_map
+    dst_local: tuple          #: (lr, lc) of the destination block
+
+    @property
+    def nslots(self) -> int:
+        return self.send_rows.shape[1]
+
+    @property
+    def rounds(self) -> int:
+        """Collective rounds this plan issues (the chain's comparison unit)."""
+        return 0 if self.kind == "local" else 1
+
+    def wire_bytes(self, itemsize: int) -> int:
+        """Ring-model bytes RECEIVED per device for one execution.
+
+        Honest about slot padding: incompatible (sender, receiver)
+        residue pairs still ship their (zero) slots, so an inflated
+        exchange prices higher than the fused chain hop -- the
+        chain-vs-direct arbitration keys off exactly this number.
+        """
+        R, C = self.slot_shape
+        slot = R * C * itemsize
+        if self.kind == "a2a":
+            return slot * (self.nslots - 1)       # K slots, keep 1/K
+        if self.kind == "ppermute":
+            return slot
+        return 0
+
+    def describe(self) -> str:
+        s = f"[{self.src[0].value},{self.src[1].value}]"
+        d = f"[{self.dst[0].value},{self.dst[1].value}]"
+        R, C = self.slot_shape
+        axes = ",".join(self.comm_axes) or "-"
+        return (f"{s}->{d}: {self.kind} over ({axes}), {self.rounds} "
+                f"round(s), {self.nslots} slot(s) of {R}x{C}")
+
+
+@functools.lru_cache(maxsize=None)
+def compile_plan(src: tuple, dst: tuple, gshape: tuple,
+                 grid_shape: tuple):
+    """Compile ``src -> dst`` on ``grid_shape`` into a one-shot plan.
+
+    Returns None when no one-shot plan exists: MD/CIRC endpoints (slot
+    permutations / eager root bridges) and ``src == dst`` (a no-op or a
+    pure re-alignment, both already optimal in the engine).
+    """
+    src, dst = tuple(src), tuple(dst)
+    r, c = grid_shape
+    p = r * c
+    if src == dst:
+        return None
+    for d in (*src, *dst):
+        if d in (MD, CIRC):
+            return None
+    m, n = gshape
+    sizes = {"mc": r, "mr": c}
+    comm = comm_axes_for(src, dst, r, c)
+    K = 1
+    for a in comm:
+        K *= sizes[a]
+
+    Ss_row, Sd_row = dist_stride(src[0], r, c), dist_stride(dst[0], r, c)
+    Ss_col, Sd_col = dist_stride(src[1], r, c), dist_stride(dst[1], r, c)
+    Lrow, Lcol = _lcm(Ss_row, Sd_row), _lcm(Ss_col, Sd_col)
+    R = max(1, -(-m // Lrow))
+    C = max(1, -(-n // Lcol))
+    src_lr, src_lc = ix.max_local_length(m, Ss_row), ix.max_local_length(n, Ss_col)
+    dst_lr, dst_lc = ix.max_local_length(m, Sd_row), ix.max_local_length(n, Sd_col)
+
+    send_rows = np.full((p, K, R), src_lr, np.int32)
+    send_cols = np.full((p, K, C), src_lc, np.int32)
+    recv_rows = np.full((p, K, R), dst_lr, np.int32)
+    recv_cols = np.full((p, K, C), dst_lc, np.int32)
+
+    def coords(d):
+        return d // c, d % c
+
+    def peer(d, k):
+        """Device at participant index k of d's comm group (the all_to_all
+        slot order: first comm axis major, matching jax's flattening)."""
+        mc_, mr_ = coords(d)
+        cs = {"mc": mc_, "mr": mr_}
+        for a in reversed(comm):
+            cs[a] = k % sizes[a]
+            k //= sizes[a]
+        return cs["mc"], cs["mr"]
+
+    def pidx(d):
+        """Participant index of device d within its own comm group."""
+        mc_, mr_ = coords(d)
+        cs = {"mc": mc_, "mr": mr_}
+        k = 0
+        for a in comm:
+            k = k * sizes[a] + cs[a]
+        return k
+
+    dims = ((m, Lrow, Ss_row, Sd_row, src_lr, dst_lr, send_rows, recv_rows, R),
+            (n, Lcol, Ss_col, Sd_col, src_lc, dst_lc, send_cols, recv_cols, C))
+
+    for d in range(p):
+        own = coords(d)
+        for k in range(K):
+            other = peer(d, k)
+            for dim, (ext, L, Ssrc, Sdst, s_len, d_len, smap, rmap, cnt) \
+                    in enumerate(dims):
+                ds_, dd_ = src[dim], dst[dim]
+                # d as SENDER to receiver `other`
+                hit = _crt(_rank_under(ds_, *own, r, c) % Ssrc, Ssrc,
+                           _rank_under(dd_, *other, r, c) % Sdst, Sdst)
+                if hit is not None:
+                    gi = hit[0] + np.arange(cnt, dtype=np.int64) * L
+                    smap[d, k, :] = np.where(gi < ext, gi // Ssrc, s_len)
+                # d as RECEIVER of slot k (sent by `other`)
+                hit = _crt(_rank_under(ds_, *other, r, c) % Ssrc, Ssrc,
+                           _rank_under(dd_, *own, r, c) % Sdst, Sdst)
+                if hit is not None:
+                    gi = hit[0] + np.arange(cnt, dtype=np.int64) * L
+                    rmap[d, k, :] = np.where(gi < ext, gi // Sdst, d_len)
+
+    kind, perm = ("local", ()) if not comm else ("a2a", ())
+    if comm:
+        ne_send = ((send_rows < src_lr).any(-1) & (send_cols < src_lc).any(-1))
+        ne_recv = ((recv_rows < dst_lr).any(-1) & (recv_cols < dst_lc).any(-1))
+        if (ne_send.sum(1) <= 1).all() and (ne_recv.sum(1) <= 1).all():
+            # wholesale relabeling candidate: one peer per device.  ppermute
+            # applies ONE perm to every group of the named axes, so demand
+            # the within-group perm be identical across groups.
+            groups: dict = {}
+            for d in range(p):
+                ks = np.nonzero(ne_send[d])[0]
+                if len(ks) == 0:
+                    continue
+                qc = peer(d, int(ks[0]))
+                q = qc[0] * c + qc[1]
+                gkey = tuple(v for a, v in zip(MESH_AXES, coords(d))
+                             if a not in comm)
+                groups.setdefault(gkey, set()).add((pidx(d), pidx(q)))
+            sets = list(groups.values())
+            if sets and all(s == sets[0] for s in sets):
+                kind = "ppermute"
+                perm = tuple(sorted(sets[0]))
+                sel_s = np.array([int(np.nonzero(ne_send[d])[0][0])
+                                  if ne_send[d].any() else 0
+                                  for d in range(p)])
+                sel_r = np.array([int(np.nonzero(ne_recv[d])[0][0])
+                                  if ne_recv[d].any() else 0
+                                  for d in range(p)])
+                ar = np.arange(p)
+                send_rows = send_rows[ar, sel_s][:, None, :]
+                send_cols = send_cols[ar, sel_s][:, None, :]
+                recv_rows = np.where(ne_recv[ar, sel_r][:, None],
+                                     recv_rows[ar, sel_r], dst_lr)[:, None, :]
+                recv_cols = np.where(ne_recv[ar, sel_r][:, None],
+                                     recv_cols[ar, sel_r], dst_lc)[:, None, :]
+
+    for t in (send_rows, send_cols, recv_rows, recv_cols):
+        t.setflags(write=False)
+    return RedistPlan(
+        src=src, dst=dst, gshape=(m, n), grid_shape=(r, c), kind=kind,
+        comm_axes=comm, perm=perm, slot_shape=(R, C),
+        send_rows=send_rows, send_cols=send_cols,
+        recv_rows=recv_rows, recv_cols=recv_cols,
+        src_local=(src_lr, src_lc), dst_local=(dst_lr, dst_lc))

@@ -240,10 +240,10 @@ def _geometry(ctx: TuneContext, nb, crossover, lookahead):
 
 
 def _trace_stats(op: str, dims_t, nb_t: int, la, xo_t, grid, dtype,
-                 panel: str = "classic"):
+                 panel: str = "classic", redist_path=None):
     """Abstract-trace ``op`` at the scaled geometry; totals memoized."""
     key = (op, dims_t, nb_t, bool(la), int(xo_t),
-           (grid.height, grid.width), str(dtype), panel)
+           (grid.height, grid.width), str(dtype), panel, redist_path)
     hit = _TRACE_MEMO.get(key)
     if hit is not None:
         return hit
@@ -266,7 +266,8 @@ def _trace_stats(op: str, dims_t, nb_t: int, la, xo_t, grid, dtype,
 
         def fn(a):
             from ..lapack.cholesky import cholesky
-            return cholesky(dm(a, n, n), nb=nb_t, lookahead=la, crossover=xo_t)
+            return cholesky(dm(a, n, n), nb=nb_t, lookahead=la, crossover=xo_t,
+                            redist_path=redist_path)
         args = (inp(n, n),)
     elif op == "lu":
         m, n = dims_t[0], dims_t[-1]
@@ -274,7 +275,7 @@ def _trace_stats(op: str, dims_t, nb_t: int, la, xo_t, grid, dtype,
         def fn(a):
             from ..lapack.lu import lu
             return lu(dm(a, m, n), nb=nb_t, lookahead=la, crossover=xo_t,
-                      panel=panel)
+                      panel=panel, redist_path=redist_path)
         args = (inp(m, n),)
     elif op == "qr":
         m, n = dims_t[0], dims_t[-1]
@@ -333,8 +334,13 @@ def _traced_cost(op: str, config: dict, ctx: TuneContext, grid, dtype,
     nb = config.get("nb")
     panel = config.get("panel") or "classic"
     cpm = config.get("comm_precision")
+    # redist_path (ISSUE 12) reaches the traced driver, so the direct
+    # route's collective counts/bytes are read off its REAL schedule --
+    # the "one a2a round vs k gather rounds" term is the trace itself.
+    # Only the ops that accept the knob get it (qr/trsm/herk chain-only).
+    rp = config.get("redist_path") if op in ("lu", "cholesky") else None
     dims_t, nb_t, xo_t, lat_scale, byte_scale = _geometry(ctx, nb, xo, la)
-    stats = _trace_stats(op, dims_t, nb_t, la, xo_t, grid, dtype, panel)
+    stats = _trace_stats(op, dims_t, nb_t, la, xo_t, grid, dtype, panel, rp)
     rounds = stats["rounds"] * lat_scale
     cbytes = stats["bytes"] * byte_scale
     wire_bytes, decode_s = _wire_terms(cbytes, cpm, machine)
@@ -350,7 +356,7 @@ def _traced_cost(op: str, config: dict, ctx: TuneContext, grid, dtype,
         detail={"trace_dims": list(dims_t), "trace_nb": nb_t,
                 "trace_crossover": xo_t, "lat_scale": round(lat_scale, 3),
                 "byte_scale": round(byte_scale, 3), "panel": panel,
-                "comm_precision": cpm})
+                "comm_precision": cpm, "redist_path": rp})
 
 
 # ---------------------------------------------------------------------
@@ -358,7 +364,7 @@ def _traced_cost(op: str, config: dict, ctx: TuneContext, grid, dtype,
 # ---------------------------------------------------------------------
 
 def _gemm_sites(alg: str, m: int, k: int, n: int, r: int, c: int,
-                nb, itemsize: int, grain_lcm: int):
+                nb, itemsize: int, grain_lcm: int, redist_path=None):
     """(site list, rounds, bytes) for one SUMMA schedule.
 
     Per-device ring-model received bytes (cf. ``analysis.jaxpr_walk
@@ -366,6 +372,13 @@ def _gemm_sites(alg: str, m: int, k: int, n: int, r: int, c: int,
     ranks costs B*(S-1); a psum costs 2*B*(S-1)/S.  Panel loops use the
     same ``blocksize_policy`` grains as the drivers, so panel counts match
     the traced schedules.
+
+    With ``redist_path='direct'`` the operand moves the drivers route
+    through the one-shot plan compiler (ISSUE 12) are priced off the
+    compiled :class:`~..redist.plan.RedistPlan` instead -- exactly one
+    collective (or zero, when the plan is local) at the plan's honest
+    padded wire bytes.  ``redist_path=None`` keeps this closed form
+    byte-identical (pinned against the abstract trace by tests/tune).
     """
     p = r * c
     z = itemsize
@@ -379,30 +392,56 @@ def _gemm_sites(alg: str, m: int, k: int, n: int, r: int, c: int,
         if s > 1:
             sites.append((tag, "psum", 2 * local_elems * z * (s - 1) // s))
 
+    def direct(tag, src_pair, dst_pair, gshape):
+        from ..redist.plan import compile_plan
+        plan = compile_plan(src_pair, dst_pair, gshape, (r, c))
+        if plan is None or plan.kind == "local":
+            return                          # zero collective rounds
+        prim = "all_to_all" if plan.kind == "a2a" else "ppermute"
+        sites.append((tag, prim, plan.wire_bytes(z)))
+
+    use_direct = redist_path == "direct" and p > 1
+    if use_direct:
+        from ..core.dist import MC, MR, VC, STAR  # jax-free taxonomy
+
     if alg == "C":
         kb = blocksize_policy(nb, grain_lcm, k)
         panels = max(1, math.ceil(k / kb))
         for _ in range(panels):
-            ag("A1->[MC,*]", (m / r) * (kb / c), c)
-            ag("B1->[*,MR]", (kb / r) * (n / c), r)
+            if use_direct:
+                direct("A1->[MC,*]", (MC, MR), (MC, STAR), (m, kb))
+                direct("B1->[*,MR]", (MC, MR), (STAR, MR), (kb, n))
+            else:
+                ag("A1->[MC,*]", (m / r) * (kb / c), c)
+                ag("B1->[*,MR]", (kb / r) * (n / c), r)
     elif alg == "A":
         jb = blocksize_policy(nb, c, n)
         panels = max(1, math.ceil(n / jb))
         for _ in range(panels):
-            ag("B1->[MR,*]", (k / c) * (jb / r), r)      # gather over mc
+            if use_direct:
+                direct("B1->[MR,*]", (MC, MR), (MR, STAR), (k, jb))
+            else:
+                ag("B1->[MR,*]", (k / c) * (jb / r), r)  # gather over mc
             ps("D1 psum(mr)", (m / r) * jb, c)
             ag("D1->[MC,MR]", (m / r) * (jb / c), 1 if c == 1 else 2)
     elif alg == "B":
         ib = blocksize_policy(nb, r, m)
         panels = max(1, math.ceil(m / ib))
         for _ in range(panels):
-            ag("A1^T->[MC,*]", (k / r) * (ib / c), c)
+            if use_direct:
+                direct("A1^T->[MC,*]", (MR, MC), (MC, STAR), (k, ib))
+            else:
+                ag("A1^T->[MC,*]", (k / r) * (ib / c), c)
             ps("D1 psum(mc)", (ib / c) * n, r)
             ag("D1->[MC,MR]", (ib / r) * (n / c), 1 if r == 1 else 2)
     elif alg == "dot":
         if p > 1:
-            ag("A->[*,VC]", m * (k / p), 2)              # cyclic re-land
-            ag("B->[VC,*]", (k / p) * n, 2)
+            if use_direct:
+                direct("A->[*,VC]", (MC, MR), (STAR, VC), (m, k))
+                direct("B->[VC,*]", (MC, MR), (VC, STAR), (k, n))
+            else:
+                ag("A->[*,VC]", m * (k / p), 2)          # cyclic re-land
+                ag("B->[VC,*]", (k / p) * n, 2)
             ps("D psum(all)", m * n, p)
             ag("D filter", (m / r) * (n / c), 1)
     elif alg == "gspmd":
@@ -423,16 +462,19 @@ def _gemm_cost(config: dict, ctx: TuneContext, itemsize: int,
     alg = config["alg"]
     nb = config.get("nb")
     cpm = config.get("comm_precision")
+    rp = config.get("redist_path")
     sites, rounds, cbytes = _gemm_sites(alg, m, k, n, r, c, nb, itemsize,
-                                        ctx.grain)
+                                        ctx.grain, redist_path=rp)
     counts: dict = {}
     for _, prim, b in sites:
         if b > 0:
             counts[prim] = counts.get(prim, 0) + 1
-    # the engine quantizes the redistribution gathers; GSPMD-inserted
-    # contraction psums stay full precision (gemm's non-SS pairs all
-    # degrade int8 -> bf16, so both modes price at the bf16 factor)
-    ag_bytes = sum(b for _, p, b in sites if p == "all_gather")
+    # the engine quantizes the redistribution collectives (gathers on the
+    # chain, the one-shot a2a/ppermute payloads on the direct route);
+    # GSPMD-inserted contraction psums stay full precision (gemm's non-SS
+    # pairs all degrade int8 -> bf16, so both modes price at bf16)
+    ag_bytes = sum(b for _, p, b in sites
+                   if p in ("all_gather", "all_to_all", "ppermute"))
     wire_ag, decode_s = _wire_terms(ag_bytes,
                                     "bf16" if cpm else None, machine)
     wire_bytes = (cbytes - ag_bytes) + wire_ag
@@ -446,7 +488,7 @@ def _gemm_cost(config: dict, ctx: TuneContext, itemsize: int,
         rounds=rounds, comm_bytes=wire_bytes, prim_counts=counts,
         detail={"sites": [{"site": t, "prim": p, "bytes": b}
                           for t, p, b in sites],
-                "comm_precision": cpm})
+                "comm_precision": cpm, "redist_path": rp})
 
 
 # ---------------------------------------------------------------------

@@ -125,6 +125,30 @@ def _with_comm_precision(space: list, ctx: TuneContext, pinned: dict) -> list:
     return [{**cfg, "comm_precision": cp} for cfg in space for cp in chosen]
 
 
+#: redistribution routes of the one-shot plan compiler (ISSUE 12, the
+#: COSTA direction): ``None`` = the factored multi-hop chain (bit-identical
+#: baseline, the candidate-order tie-break leader), ``'direct'`` = the
+#: compiled single-collective plan (``redist.plan``).  Kept in sync with
+#: ``redist.engine.REDIST_PATHS`` (pinned by tests/tune).
+REDIST_PATHS = (None, "direct")
+
+
+def _with_redist_path(space: list, ctx: TuneContext, pinned: dict) -> list:
+    """Cross every candidate with the legal redist_path values.
+
+    An explicitly pinned value (INCLUDING ``None``) freezes the
+    dimension; otherwise single-device grids enumerate only ``None``
+    (every plan is 'local' there -- no collective to save) and
+    multi-device grids sweep chain vs direct."""
+    if "redist_path" in pinned:
+        chosen = (pinned["redist_path"],)
+    elif ctx.grid_size <= 1:
+        chosen = (None,)
+    else:
+        chosen = REDIST_PATHS
+    return [{**cfg, "redist_path": rp} for cfg in space for rp in chosen]
+
+
 #: panel strategies of the pivoted/reflector factorizations (ISSUE 6):
 #: 'classic' = replicated column-at-a-time panel (the stability baseline),
 #: the alternative = communication-avoiding tree panel (CALU tournament
@@ -149,15 +173,17 @@ def _with_panels(space: list, ctx: TuneContext, pinned: dict,
 
 
 def _cholesky_space(ctx: TuneContext, pinned: dict) -> list:
-    return _with_comm_precision(_factorization_space(ctx, pinned), ctx,
-                                pinned)
+    return _with_redist_path(
+        _with_comm_precision(_factorization_space(ctx, pinned), ctx,
+                             pinned), ctx, pinned)
 
 
 def _lu_space(ctx: TuneContext, pinned: dict) -> list:
-    base = {k: v for k, v in pinned.items() if k != "panel"}
-    return _with_comm_precision(
-        _with_panels(_factorization_space(ctx, base), ctx, pinned,
-                     LU_PANELS), ctx, pinned)
+    base = {k: v for k, v in pinned.items() if k not in ("panel",)}
+    return _with_redist_path(
+        _with_comm_precision(
+            _with_panels(_factorization_space(ctx, base), ctx, pinned,
+                         LU_PANELS), ctx, pinned), ctx, pinned)
 
 
 def _qr_space(ctx: TuneContext, pinned: dict) -> list:
@@ -190,7 +216,8 @@ def _gemm_space(ctx: TuneContext, pinned: dict) -> list:
             out.append({"alg": alg, "nb": nb})
             if alg in ("dot", "gspmd"):
                 break                     # nb is dead for the one-shot algs
-    return _with_comm_precision(out, ctx, pinned)
+    return _with_redist_path(_with_comm_precision(out, ctx, pinned), ctx,
+                             pinned)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -203,12 +230,14 @@ class OpSpace:
 
 OPS = {
     "cholesky": OpSpace("cholesky",
-                        ("nb", "lookahead", "crossover", "comm_precision"),
+                        ("nb", "lookahead", "crossover", "comm_precision",
+                         "redist_path"),
                         _cholesky_space),
     "lu": OpSpace("lu", ("nb", "lookahead", "crossover", "panel",
-                         "comm_precision"), _lu_space),
+                         "comm_precision", "redist_path"), _lu_space),
     "qr": OpSpace("qr", ("nb", "panel", "comm_precision"), _qr_space),
-    "gemm": OpSpace("gemm", ("alg", "nb", "comm_precision"), _gemm_space),
+    "gemm": OpSpace("gemm", ("alg", "nb", "comm_precision", "redist_path"),
+                    _gemm_space),
     "trsm": OpSpace("trsm", ("nb", "comm_precision"), _nb_comm_space),
     "herk": OpSpace("herk", ("nb", "comm_precision"), _nb_comm_space),
 }

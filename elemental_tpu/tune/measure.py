@@ -93,6 +93,7 @@ def _builders(op: str, dims, grid, dtype):
                 a, nb=cfg.get("nb"), lookahead=cfg.get("lookahead", True),
                 crossover=cfg.get("crossover"),
                 comm_precision=cfg.get("comm_precision"),
+                redist_path=cfg.get("redist_path"),
                 precision=HI).local,
                 donate_argnums=0)
         return make, factory
@@ -109,7 +110,8 @@ def _builders(op: str, dims, grid, dtype):
                 a, nb=cfg.get("nb"), lookahead=cfg.get("lookahead", True),
                 crossover=cfg.get("crossover"),
                 panel=cfg.get("panel") or "classic",
-                comm_precision=cfg.get("comm_precision"), precision=HI)),
+                comm_precision=cfg.get("comm_precision"),
+                redist_path=cfg.get("redist_path"), precision=HI)),
                 donate_argnums=0)
         return make, factory
     if op == "qr":
@@ -180,6 +182,7 @@ def _builders(op: str, dims, grid, dtype):
                 ab[0], ab[1], alg=cfg.get("alg", "auto"),
                 nb=cfg.get("nb"),
                 comm_precision=cfg.get("comm_precision"),
+                redist_path=cfg.get("redist_path"),
                 precision=HI).local,
                 donate_argnums=0)
         return make, factory

@@ -14,6 +14,8 @@ registered distributed drivers abstractly (no device execution; forces an
     python -m perf.comm_audit diff cholesky --update-golden
     python -m perf.comm_audit lint --all               # rule-based lints;
                                                        #   exit 1 on findings
+    python -m perf.comm_audit lint --all --fix-hint    # + print each
+                                                       #   finding's rewrite
 
 ``diff`` exits non-zero when any plan deviates from its golden snapshot
 under ``tests/golden/comm_plans/`` (regenerate with ``--update-golden``
@@ -128,7 +130,7 @@ def cmd_diff(drivers, grids, n, nb, update: bool) -> int:
     return 1 if bad else 0
 
 
-def cmd_lint(drivers, grids, n, nb) -> int:
+def cmd_lint(drivers, grids, n, nb, fix_hint: bool = False) -> int:
     from elemental_tpu.analysis import lint_plan
     total = 0
     for driver in drivers:
@@ -137,6 +139,8 @@ def cmd_lint(drivers, grids, n, nb) -> int:
             findings = lint_plan(plan, log, closed)
             for f in findings:
                 print(f"{driver} {grid[0]}x{grid[1]}: {f}")
+                if fix_hint and f.fix_hint:
+                    print(f"  fix: {f.fix_hint}")
             total += len(findings)
     print(f"{total} finding(s)")
     return 1 if total else 0
@@ -155,7 +159,7 @@ def main(argv=None) -> int:
     name = None
     grids = list(GRIDS)
     n = nb = None
-    events = update = False
+    events = update = fix_hint = False
     it = iter(argv)
     for arg in it:
         if arg == "--grid":
@@ -169,6 +173,8 @@ def main(argv=None) -> int:
             events = True
         elif arg == "--update-golden":
             update = True
+        elif arg == "--fix-hint":
+            fix_hint = True
         elif arg == "--all":
             name = None
         elif arg.startswith("--"):
@@ -180,7 +186,7 @@ def main(argv=None) -> int:
         return cmd_audit(drivers, grids, n, nb, events)
     if cmd == "diff":
         return cmd_diff(drivers, grids, n, nb, update)
-    return cmd_lint(drivers, grids, n, nb)
+    return cmd_lint(drivers, grids, n, nb, fix_hint)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,274 @@
+"""Redistribution microbench: src->dst x geometry x path matrix (ISSUE 12).
+
+Times the SAME redistribution through the chained multi-hop engine
+(``path='chain'``) and the one-shot compiled plan (``path='direct'``) on
+the live device grid, roofline-bracketed like ``perf/ab_harness.py`` so
+chip weather is factored out of an A/B pair.  Each row prints as one
+``redist_bench/v1`` JSON line:
+
+    {"schema": "redist_bench/v1", "pair": "[MC,MR]->[MR,STAR]",
+     "grid": "2x4", "n": 4096, "path": "direct", "plan": "a2a",
+     "rounds": 1, "model_bytes": ..., "seconds": ..., "gbps": ...,
+     "roof_tflops": [r_before, r_after], "match": true}
+
+``model_bytes`` is the ring-model per-device wire estimate (the same
+alpha-beta terms the tuner's cost model and the ``'auto'`` path arbiter
+price: chain legs at all_gather/all_to_all/ppermute ring cost, the direct
+plan at its single-collective slot volume), so ``gbps`` is MODEL bytes
+over measured seconds -- comparable across paths, not a NIC counter.
+``match`` cross-checks the two paths bit-identically via ``to_global``
+before timing (the bench never reports a speedup for a wrong answer).
+
+Usage:
+
+    python -m perf.redist_bench                   # default pair matrix on
+                                                  #   the full device grid
+    python -m perf.redist_bench --smoke           # 1x1 grid, n=64, two
+                                                  #   pairs, tiny roofline
+    python -m perf.redist_bench --n 4096 --grid 2x4 --paths chain,direct
+    python -m perf.redist_bench --pairs "MC,MR->MR,STAR;VC,STAR->VR,STAR"
+
+On a CPU-only host run under
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` (set automatically
+when unset) so the multi-chip grids exist; timings there are functional,
+not representative -- the bench is for TPU pods, the smoke mode for CI.
+"""
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: default src->dst matrix: one representative of each plan regime --
+#: the 3-hop gather chains gemm feeds on, a pure relabeling (ppermute),
+#: a replication (fused all_gather chain vs one-shot a2a+concat), and a
+#: transpose-style move.
+DEFAULT_PAIRS = (
+    ("MC,MR", "MR,STAR"),
+    ("MC,MR", "STAR,VC"),
+    ("MC,MR", "STAR,STAR"),
+    ("VC,STAR", "VR,STAR"),
+    ("MC,MR", "MR,MC"),
+    ("VC,STAR", "MC,STAR"),
+)
+
+SMOKE_PAIRS = DEFAULT_PAIRS[:2]
+
+
+def _bootstrap():
+    """Make multi-device grids exist on CPU-only hosts (virtual devices
+    must be requested BEFORE jax initializes); never downgrades a TPU."""
+    if _REPO not in sys.path:
+        sys.path.insert(0, _REPO)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _min_t(fn, reps):
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def _dist_pair(spec: str):
+    import elemental_tpu as el
+    by_name = {d.value: d for d in
+               (el.MC, el.MR, el.VC, el.VR, el.STAR, el.MD, el.CIRC)}
+    try:
+        c, r = (by_name[s.strip().upper()] for s in spec.split(","))
+    except (KeyError, ValueError):
+        raise SystemExit(f"bad dist pair {spec!r}; want e.g. 'MC,MR'")
+    return (c, r)
+
+
+def _parse_pairs(arg: str):
+    out = []
+    for leg in arg.split(";"):
+        src, _, dst = leg.partition("->")
+        if not dst:
+            raise SystemExit(f"bad pair {leg!r}; want 'MC,MR->MR,STAR'")
+        out.append((src.strip(), dst.strip()))
+    return tuple(out)
+
+
+def _label(pair) -> str:
+    return f"[{pair[0].value},{pair[1].value}]"
+
+
+def _roofline(n: int) -> float:
+    """Matmul roofline at size n (chip-weather bracket, ab_harness idiom)."""
+    import jax
+    import jax.numpy as jnp
+    HI = jax.lax.Precision.HIGHEST
+    x = jax.random.normal(jax.random.PRNGKey(9), (n, n), jnp.float32)
+    mm = jax.jit(lambda a: jnp.matmul(a, a, precision=HI))
+    float(mm(x)[0, 0])                       # compile, untimed
+    dt = max(_min_t(lambda: float(mm(x)[0, 0]), 3), 1e-9)
+    return 2 * n ** 3 / dt / 1e12
+
+
+def _model_bytes(src, dst, gshape, grid_shape, itemsize, path):
+    """Ring-model per-device wire estimate for one redistribution: the
+    chain priced leg by leg, the direct path by its compiled plan."""
+    from elemental_tpu.redist.engine import chain_cost
+    from elemental_tpu.redist.plan import compile_plan
+    if path == "direct":
+        plan = compile_plan(src, dst, gshape, grid_shape)
+        if plan is not None:
+            return plan.rounds, plan.wire_bytes(itemsize), plan.kind
+        path = "chain"                       # engine falls back identically
+    rounds, nbytes = chain_cost(src, dst, gshape, grid_shape, itemsize)
+    return rounds, nbytes, "chain"
+
+
+def run_pair(grid, n, src, dst, paths, reps=3, check=True):
+    """Time one src->dst move under each path; returns a list of row dicts
+    (no JSON printing -- the CLI and bench.py both feed from here)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import elemental_tpu as el
+
+    host = np.asarray(
+        np.arange(n * n, dtype=np.float32).reshape(n, n) % 1013 / 7.0)
+    A = el.from_global(jnp.asarray(host), src[0], src[1], grid)
+    grid_shape = (grid.height, grid.width)
+    itemsize = jnp.dtype(A.dtype).itemsize
+
+    match = None
+    if check:
+        outs = [np.asarray(el.to_global(
+            el.redistribute(A, dst[0], dst[1], path=p))) for p in paths]
+        match = all(np.array_equal(outs[0], o) for o in outs[1:]) \
+            and np.array_equal(outs[0], host)
+
+    rows = []
+    for path in paths:
+        out = el.redistribute(A, dst[0], dst[1], path=path)   # warm cache
+        jax.block_until_ready(out.local)
+
+        def _step(p=path):
+            o = el.redistribute(A, dst[0], dst[1], path=p)
+            float(jnp.ravel(o.local)[0])     # force completion (ab_harness)
+
+        dt = max(_min_t(_step, reps), 1e-9)
+        rounds, nbytes, plan_kind = _model_bytes(
+            src, dst, (n, n), grid_shape, itemsize, path)
+        rows.append({
+            "schema": "redist_bench/v1",
+            "pair": f"{_label(src)}->{_label(dst)}",
+            "grid": f"{grid.height}x{grid.width}",
+            "n": n,
+            "path": path,
+            "plan": plan_kind,
+            "rounds": rounds,
+            "model_bytes": nbytes,
+            "seconds": dt,
+            "gbps": nbytes / dt / 1e9,
+            "match": match,
+        })
+    return rows
+
+
+def p2p_gbps(grid, n=None, reps=3):
+    """Informational chain-vs-direct GB/s for ONE representative move
+    ([MC,MR]->[MR,STAR], the 3-hop chain gemm's stationary-C schedule
+    feeds on) -- the ``redist_p2p_gbps`` row bench.py embeds in its obs
+    block.  Returns ``{"chain": gbps, "direct": gbps, ...}``; on a 1x1
+    grid both model-byte counts are zero, so both rates report 0.0.
+    Never raises past bad geometry: callers gate it defensively anyway."""
+    import elemental_tpu as el
+    if n is None:
+        n = 256 if grid.size <= 8 else 4096
+    src = _dist_pair("MC,MR")
+    dst = _dist_pair("MR,STAR")
+    rows = run_pair(grid, n, src, dst, ("chain", "direct"),
+                    reps=reps, check=False)
+    doc = {"pair": rows[0]["pair"], "n": n,
+           "grid": rows[0]["grid"]}
+    for row in rows:
+        doc[row["path"]] = round(row["gbps"], 4)
+    return doc
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    _bootstrap()
+    import jax
+    import elemental_tpu as el
+
+    smoke = "--smoke" in argv
+    n = 64 if smoke else None
+    grids = None
+    paths = ("chain", "direct")
+    pairs = SMOKE_PAIRS if smoke else DEFAULT_PAIRS
+    reps = 3
+    it = iter(argv)
+    for arg in it:
+        if arg == "--smoke":
+            continue
+        elif arg == "--n":
+            n = int(next(it))
+        elif arg == "--grid":
+            r, c = next(it).split("x")
+            grids = [(int(r), int(c))]
+        elif arg == "--paths":
+            paths = tuple(p.strip() for p in next(it).split(","))
+        elif arg == "--pairs":
+            pairs = _parse_pairs(next(it))
+        elif arg == "--reps":
+            reps = int(next(it))
+        else:
+            raise SystemExit(f"unknown flag {arg!r}")
+
+    devs = jax.devices()
+    if grids is None:
+        if smoke:
+            grids = [(1, 1)]
+        else:
+            # full device grid, plus a 1-row layout when it differs (the
+            # same chips as a different geometry move different bytes)
+            p = len(devs)
+            r = 1
+            for q in range(int(p ** 0.5), 0, -1):
+                if p % q == 0:
+                    r = q
+                    break
+            grids = [(r, p // r)] if r == 1 else [(r, p // r), (1, p)]
+    if n is None:
+        n = 256 if devs[0].platform == "cpu" else 4096
+
+    roof_n = 256 if smoke or devs[0].platform == "cpu" else 8192
+    for gr, gc in grids:
+        if gr * gc > len(devs):
+            print(f"# skip {gr}x{gc}: only {len(devs)} device(s)",
+                  file=sys.stderr)
+            continue
+        grid = el.Grid(devs[: gr * gc], height=gr)
+        r0 = _roofline(roof_n)
+        rows = []
+        for src_s, dst_s in pairs:
+            src, dst = _dist_pair(src_s), _dist_pair(dst_s)
+            rows += run_pair(grid, n, src, dst, paths, reps=reps)
+        r1 = _roofline(roof_n)
+        for row in rows:
+            row["roof_tflops"] = [round(r0, 3), round(r1, 3)]
+            print(json.dumps(row))
+            if row["match"] is False:
+                print(f"# MISMATCH {row['pair']} on {row['grid']}",
+                      file=sys.stderr)
+                return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

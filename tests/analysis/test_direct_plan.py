@@ -1,0 +1,70 @@
+"""The ``*_direct`` analysis drivers (ISSUE 12): each one-shot gemm
+variant pins STRICTLY fewer total collective rounds than its chained twin
+on the 2x2 grid (the acceptance criterion of the plan compiler), stays
+no worse on 1x1 (where every plan is 'local' and the chain still issues
+degenerate 1-participant collectives), and every registered ``*_direct``
+driver has committed comm-plan goldens for every audit grid."""
+import os
+
+import jax
+import pytest
+
+from elemental_tpu import Grid
+from elemental_tpu import analysis as an
+from elemental_tpu.analysis import DIRECT_PAIRS
+
+DIRECT_IDS = [d for d, _ in DIRECT_PAIRS]
+
+
+def _total_rounds(driver, grid):
+    g = Grid(jax.devices()[: grid[0] * grid[1]], height=grid[0])
+    plan, _, _ = an.trace_driver(driver, g)
+    return sum(v["count"] for v in plan.totals().values())
+
+
+def test_direct_variants_registered():
+    names = set(an.driver_names())
+    assert {"gemm_a_direct", "gemm_b_direct", "gemm_dot_direct"} <= names
+    for direct, chain in DIRECT_PAIRS:
+        assert direct in names and chain in names
+
+
+@pytest.mark.parametrize("direct,chain", DIRECT_PAIRS, ids=DIRECT_IDS)
+def test_direct_strictly_fewer_rounds_on_2x2(direct, chain):
+    """THE acceptance pin: the one-shot schedule issues strictly fewer
+    collective rounds than the multi-hop chain on a real 2-D grid."""
+    assert _total_rounds(direct, (2, 2)) < _total_rounds(chain, (2, 2))
+
+
+@pytest.mark.parametrize("direct,chain", DIRECT_PAIRS, ids=DIRECT_IDS)
+def test_direct_no_worse_on_1x1(direct, chain):
+    """On 1x1 every compiled plan is 'local' (zero collectives), while
+    the chain still emits degenerate 1-participant rounds -- the direct
+    variant must be <=, never more."""
+    assert _total_rounds(direct, (1, 1)) <= _total_rounds(chain, (1, 1))
+
+
+def test_direct_uses_one_shot_collectives_on_2x2():
+    """The direct gemm schedules move operands via all_to_all/ppermute
+    plans, never the chain's per-hop all_gather."""
+    totals = {}
+    for direct, _ in DIRECT_PAIRS:
+        g = Grid(jax.devices()[:4], height=2)
+        plan, _, _ = an.trace_driver(direct, g)
+        totals[direct] = plan.totals()
+    for direct, t in totals.items():
+        assert "all_gather" not in t, (direct, t)
+
+
+def test_every_direct_driver_has_goldens():
+    """tools/check.sh's golden-coverage sweep runs driver_names() x GRIDS;
+    a *_direct variant without committed goldens breaks the gate -- catch
+    it here with a named message instead."""
+    from perf.comm_audit import GRIDS, golden_path
+    missing = [
+        os.path.relpath(golden_path(name, grid))
+        for name in an.driver_names() if name.endswith("_direct")
+        for grid in GRIDS
+        if not os.path.exists(golden_path(name, grid))
+    ]
+    assert not missing, f"regenerate with --update-golden: {missing}"

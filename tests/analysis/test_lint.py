@@ -54,6 +54,17 @@ def test_seeded_round_trip_reported(g22):
     assert "[MC,MR]->[VC,STAR]" in msg and "[VC,STAR]->[MC,MR]" in msg
 
 
+def test_round_trip_fix_hint_quotes_the_direct_plan(g22):
+    """ISSUE 12: the EL002 finding carries the one-shot rewrite -- the
+    compiled direct plan's kind/rounds/bytes next to the chain's."""
+    findings = _lint(g22, _toy(g22, round_trip=True))
+    hint = next(f.fix_hint for f in findings if f.rule == "EL002")
+    assert "path='direct'" in hint
+    assert "[MC,MR]->[VC,STAR]" in hint
+    assert "'a2a'" in hint or "'ppermute'" in hint
+    assert "round(s)" in hint and "vs the chain's" in hint
+
+
 def test_round_trip_removed_passes(g22):
     assert _lint(g22, _toy(g22, round_trip=False)) == []
 
@@ -124,4 +135,7 @@ def test_comm_audit_lint_cli_exit_codes(g22, monkeypatch, capsys):
     from perf import comm_audit
     assert comm_audit.main(["lint", "cholesky_crossover", "--grid", "2x2"]) == 0
     assert comm_audit.main(["diff", "cholesky", "--grid", "2x2"]) == 0
+    # --fix-hint is accepted (clean registry: nothing to print)
+    assert comm_audit.main(["lint", "cholesky_crossover", "--grid", "2x2",
+                            "--fix-hint"]) == 0
     capsys.readouterr()

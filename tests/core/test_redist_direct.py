@@ -1,0 +1,280 @@
+"""One-shot redistribution (ISSUE 12): plan-compiler unit behavior plus
+the direct-vs-chain bit-equivalence conformance matrix.
+
+The compiled plan replaces a multi-hop chain with a single collective, so
+the contract is EXACT: for every legal (src, dst) pair, every grid shape,
+and ragged extents, ``path='direct'`` must produce the same storage-form
+locals bit for bit as the historical chain -- a permutation of the same
+payload bytes admits no tolerance.  The comm_precision codec composes:
+bf16 rides the direct plan bit-identically to the chained bf16 wire
+(bf16 rounding is idempotent across hops), int8 block-scale stays inside
+its published error bound (tiling differs from the chain's fused kernel,
+so the int8 cross-check is against full precision, not chain-int8).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from elemental_tpu import (LEGAL_PAIRS, Grid, from_global, to_global,
+                           redistribute)
+from elemental_tpu.core.dist import Dist
+from elemental_tpu.redist import engine
+from elemental_tpu.redist.plan import compile_plan, comm_axes_for
+
+MC, MR, VC, VR = Dist.MC, Dist.MR, Dist.VC, Dist.VR
+STAR, MD, CIRC = Dist.STAR, Dist.MD, Dist.CIRC
+
+PAIR_IDS = [f"{p[0].value},{p[1].value}" for p in LEGAL_PAIRS]
+
+
+def f(m, n):
+    i = np.arange(m)[:, None]
+    j = np.arange(n)[None, :]
+    return (i * 997.0 + j + 1).astype(np.float64)
+
+
+@pytest.fixture(scope="module")
+def g11():
+    return Grid(jax.devices()[:1])
+
+
+@pytest.fixture(scope="module")
+def g22():
+    return Grid(jax.devices()[:4], height=2)
+
+
+# ---------------------------------------------------------------------
+# plan compiler units (pure index math, no device execution)
+# ---------------------------------------------------------------------
+
+def test_plan_none_for_noop_and_root_only_dists():
+    assert compile_plan((MC, MR), (MC, MR), (16, 16), (2, 2)) is None
+    assert compile_plan((MC, MR), (MD, STAR), (16, 16), (2, 2)) is None
+    assert compile_plan((CIRC, CIRC), (MC, MR), (16, 16), (2, 2)) is None
+
+
+def test_plan_kinds_2x2():
+    # pure relabelings compile to one ppermute hop
+    assert compile_plan((MC, MR), (MR, MC), (16, 16), (2, 2)).kind \
+        == "ppermute"
+    assert compile_plan((VC, STAR), (VR, STAR), (16, 16), (2, 2)).kind \
+        == "ppermute"
+    # genuine reshuffles compile to one all_to_all
+    for dst in ((STAR, STAR), (MR, STAR)):
+        p = compile_plan((MC, MR), dst, (16, 16), (2, 2))
+        assert p.kind == "a2a" and p.rounds == 1 and p.nslots == 4
+        assert set(p.comm_axes) == {"mc", "mr"}
+
+
+def test_plan_local_on_1x1():
+    p = compile_plan((MC, MR), (MR, STAR), (16, 16), (1, 1))
+    assert p.kind == "local" and p.rounds == 0 and p.wire_bytes(8) == 0
+
+
+def test_wire_bytes_ring_model():
+    p = compile_plan((MC, MR), (STAR, STAR), (16, 16), (2, 2))
+    R, C = p.slot_shape
+    assert p.wire_bytes(4) == R * C * 4 * (p.nslots - 1)
+    pp = compile_plan((VC, STAR), (VR, STAR), (16, 16), (2, 2))
+    R, C = pp.slot_shape
+    assert pp.wire_bytes(4) == R * C * 4
+
+
+def test_chain_cost_mirror():
+    """The engine's chain-round mirror prices the factored dispatch the
+    'auto' arbiter and EL002 fix hints compare against."""
+    assert engine.chain_cost((MC, MR), (MC, MR), (32, 32), (2, 2), 4) \
+        == (0, 0)
+    assert engine.chain_cost((MC, MR), (MR, STAR), (32, 32), (1, 1), 4) \
+        == (0, 0)
+    rounds, nbytes = engine.chain_cost(
+        (MC, MR), (MR, STAR), (32, 32), (2, 2), 4)
+    assert rounds == 3 and nbytes > 0        # the 3-hop gather chain
+    rounds_ss, _ = engine.chain_cost(
+        (MC, MR), (STAR, STAR), (32, 32), (2, 2), 4)
+    assert rounds_ss == 1                    # fused gather-to-replicated
+    # the one-shot plan strictly beats the 3-hop chain on rounds
+    assert compile_plan((MC, MR), (MR, STAR), (32, 32), (2, 2)).rounds \
+        < rounds
+
+
+def test_comm_axes_subset_of_mesh():
+    axes = comm_axes_for((MC, MR), (MR, STAR), 2, 2)
+    assert axes and set(axes) <= {"mc", "mr"}
+
+
+# ---------------------------------------------------------------------
+# direct-vs-chain bit-equivalence matrix
+# ---------------------------------------------------------------------
+
+def _check_pair(grid, src, dst, F):
+    A = from_global(F, *src, grid=grid)
+    Bc = redistribute(A, *dst, path="chain")
+    Bd = redistribute(A, *dst, path="direct")
+    assert Bd.dist == dst and (Bd.calign, Bd.ralign) == (Bc.calign, Bc.ralign)
+    np.testing.assert_array_equal(np.asarray(Bd.local), np.asarray(Bc.local))
+    np.testing.assert_array_equal(np.asarray(to_global(Bd)), F)
+
+
+@pytest.mark.parametrize("dst", LEGAL_PAIRS, ids=PAIR_IDS)
+@pytest.mark.parametrize("src", LEGAL_PAIRS, ids=PAIR_IDS)
+def test_direct_matches_chain_2x2(g22, src, dst):
+    _check_pair(g22, src, dst, f(13, 9))
+
+
+@pytest.mark.parametrize("dst", LEGAL_PAIRS, ids=PAIR_IDS)
+@pytest.mark.parametrize("src", LEGAL_PAIRS, ids=PAIR_IDS)
+def test_direct_matches_chain_1x1(g11, src, dst):
+    _check_pair(g11, src, dst, f(13, 9))
+
+
+#: cheap 2x4 tier: one representative per plan regime (gather chains,
+#: relabelings, replication, transpose); the full matrix is slow-tier
+_SUBSET_24 = (
+    ((MC, MR), (MR, STAR)), ((MC, MR), (STAR, VC)),
+    ((MC, MR), (STAR, STAR)), ((VC, STAR), (VR, STAR)),
+    ((MC, MR), (MR, MC)), ((VC, STAR), (MC, STAR)),
+    ((STAR, VR), (MC, MR)), ((MR, STAR), (VC, STAR)),
+    ((STAR, MC), (MC, MR)), ((VR, STAR), (MC, MR)),
+    ((MC, STAR), (STAR, MR)), ((STAR, STAR), (MC, MR)),
+    ((STAR, VC), (VC, STAR)), ((MR, MC), (MC, MR)),
+    ((VC, STAR), (STAR, STAR)), ((STAR, MR), (MR, STAR)),
+    ((MD, STAR), (MC, MR)), ((MC, MR), (CIRC, CIRC)),
+    ((CIRC, CIRC), (MC, MR)), ((MC, MR), (MD, STAR)),
+)
+
+
+@pytest.mark.parametrize(
+    "src,dst", _SUBSET_24,
+    ids=[f"{s[0].value},{s[1].value}->{d[0].value},{d[1].value}"
+         for s, d in _SUBSET_24])
+def test_direct_matches_chain_2x4_subset(grid24, src, dst):
+    _check_pair(grid24, src, dst, f(19, 11))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("dst", LEGAL_PAIRS, ids=PAIR_IDS)
+@pytest.mark.parametrize("src", LEGAL_PAIRS, ids=PAIR_IDS)
+def test_direct_matches_chain_2x4_full(grid24, src, dst):
+    _check_pair(grid24, src, dst, f(19, 11))
+
+
+# ---------------------------------------------------------------------
+# comm_precision codec composition
+# ---------------------------------------------------------------------
+
+def _frac(m, n):
+    rng = np.random.default_rng(7)
+    return (rng.standard_normal((m, n)) * 3).astype(np.float32)
+
+
+@pytest.mark.parametrize("dst", [(MR, STAR), (STAR, VC), (STAR, STAR)],
+                         ids=lambda p: f"{p[0].value},{p[1].value}")
+def test_direct_bf16_bit_identical_to_chain_bf16(g22, dst):
+    """bf16 rounding is idempotent, so one encode on the direct plan
+    lands the same bits as the chain's per-hop narrow wire."""
+    F = _frac(13, 9)
+    A = from_global(F, MC, MR, grid=g22)
+    Bc = redistribute(A, *dst, comm_precision="bf16", path="chain")
+    Bd = redistribute(A, *dst, comm_precision="bf16", path="direct")
+    np.testing.assert_array_equal(np.asarray(Bc.local), np.asarray(Bd.local))
+    # and the narrow wire actually rounded something (the test is live)
+    assert not np.array_equal(np.asarray(to_global(Bd)), F)
+
+
+@pytest.mark.parametrize("dst", [(MR, STAR), (STAR, STAR)],
+                         ids=lambda p: f"{p[0].value},{p[1].value}")
+def test_direct_int8_within_block_scale_bound(g22, dst):
+    """int8 on the direct plan block-scale-packs every slot; its tiling
+    differs from the chain's fused gather kernel, so the cross-check is
+    the published error bound against FULL precision."""
+    F = _frac(13, 9)
+    A = from_global(F, MC, MR, grid=g22)
+    out = np.asarray(to_global(
+        redistribute(A, *dst, comm_precision="int8", path="direct")))
+    assert np.max(np.abs(out - F)) <= np.abs(F).max() / 127.0 + 1e-7
+
+
+def test_unquantized_direct_ignores_codec_on_1x1(g11):
+    F = _frac(13, 9)
+    A = from_global(F, MC, MR, grid=g11)
+    out = redistribute(A, MR, STAR, comm_precision="int8", path="direct")
+    np.testing.assert_array_equal(np.asarray(to_global(out)), F)
+
+
+# ---------------------------------------------------------------------
+# routing: 'auto', validation, trace records
+# ---------------------------------------------------------------------
+
+def test_paths_registry_pinned():
+    assert engine.REDIST_PATHS == (None, "chain", "direct", "auto")
+
+
+def test_invalid_path_raises(g22):
+    A = from_global(f(8, 8), MC, MR, grid=g22)
+    with pytest.raises(ValueError, match="path"):
+        redistribute(A, MR, STAR, path="oneshot")
+
+
+@pytest.mark.parametrize("dst", [(MR, STAR), (STAR, STAR), (VR, STAR)],
+                         ids=lambda p: f"{p[0].value},{p[1].value}")
+def test_auto_path_correct_on_both_grids(g11, g22, dst):
+    F = f(13, 9)
+    for grid in (g11, g22):
+        A = from_global(F, MC, MR, grid=grid)
+        with engine.redist_trace() as log:
+            B = redistribute(A, *dst, path="auto")
+        np.testing.assert_array_equal(np.asarray(to_global(B)), F)
+        assert log[-1].path in ("chain", "direct")
+
+
+def test_trace_records_carry_path_rounds_bytes(g22):
+    F = f(16, 8)
+    A = from_global(F, MC, MR, grid=g22)
+    with engine.redist_trace() as log:
+        redistribute(A, MR, STAR, path="chain")
+        redistribute(A, MR, STAR, path="direct")
+    chain_rec, direct_rec = log[-2:]
+    assert chain_rec.path == "chain" and chain_rec.rounds == 3
+    assert direct_rec.path == "direct" and direct_rec.rounds == 1
+    assert chain_rec.wire_bytes > 0 and direct_rec.wire_bytes > 0
+
+
+def test_obs_comm_events_carry_path_fields(g22):
+    """The obs tracer's CommEvent records which route each entry took
+    (ADVICE.md: read ``path``/``rounds``/``engine_wire_bytes`` to tell
+    one-shot plans from chains in a trace) without disturbing the
+    ring-model wire_bytes accounting older tests pin."""
+    from elemental_tpu.obs.tracer import Tracer
+    F = f(16, 8)
+    A = from_global(F, MC, MR, grid=g22)
+    with Tracer() as tr:
+        redistribute(A, MR, STAR, path="chain")
+        redistribute(A, MR, STAR, path="direct")
+    chain_ev, direct_ev = tr.comms[-2:]
+    assert chain_ev.path == "chain" and chain_ev.rounds == 3
+    assert direct_ev.path == "direct" and direct_ev.rounds == 1
+    assert direct_ev.engine_wire_bytes > 0
+    # the ring-model estimate is path-independent (same logical move)
+    assert chain_ev.wire_bytes == direct_ev.wire_bytes == chain_ev.bytes
+
+
+def test_row_permute_records_reach_observers_not_goldens(g22):
+    """move_rows/permute_rows_storage publish their GSPMD-planned motion
+    to engine observers (the obs tracer must account the traffic) but
+    stay OUT of redist_trace -- the comm-plan goldens pin explicit
+    collective rounds only."""
+    A = from_global(f(13, 9), MC, MR, grid=g22)
+    perm = np.arange(13)
+    perm[[0, 5]] = perm[[5, 0]]
+    seen = []
+    unobserve = engine.add_redist_observer(seen.append)
+    try:
+        with engine.redist_trace() as log:
+            engine.permute_rows_storage(A, jnp.asarray(perm))
+    finally:
+        unobserve()
+    assert any(r.kind == "row_permute" for r in seen)
+    assert not any(r.kind == "row_permute" for r in log)

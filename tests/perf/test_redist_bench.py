@@ -1,0 +1,39 @@
+"""perf.redist_bench smoke (ISSUE 12 satellite): the chain-vs-direct
+microbench emits well-formed ``redist_bench/v1`` rows with the bit-match
+cross-check green, and the ``p2p_gbps`` helper feeding bench.py's obs
+block returns both paths."""
+import json
+
+
+def test_run_pair_rows_and_match(grid24):
+    from perf.redist_bench import run_pair, _dist_pair
+    rows = run_pair(grid24, 24, _dist_pair("MC,MR"), _dist_pair("MR,STAR"),
+                    ("chain", "direct"), reps=1, check=True)
+    assert [r["path"] for r in rows] == ["chain", "direct"]
+    for row in rows:
+        assert row["schema"] == "redist_bench/v1"
+        assert row["pair"] == "[MC,MR]->[MR,STAR]"
+        assert row["match"] is True
+        assert row["seconds"] > 0 and row["model_bytes"] >= 0
+        json.dumps(row)                      # one JSON line per row
+    chain, direct = rows
+    assert chain["rounds"] >= direct["rounds"]
+    assert direct["plan"] in ("a2a", "ppermute", "local")
+
+
+def test_p2p_gbps_reports_both_paths(grid24):
+    from perf.redist_bench import p2p_gbps
+    doc = p2p_gbps(grid24, n=24, reps=1)
+    assert set(doc) >= {"pair", "n", "grid", "chain", "direct"}
+    assert doc["chain"] >= 0.0 and doc["direct"] >= 0.0
+
+
+def test_cli_smoke_exits_zero(capsys):
+    """``--smoke`` is the tools/check.sh gate: tiny 1x1 matrix, every
+    row parses, exit 0."""
+    from perf import redist_bench
+    assert redist_bench.main(["--smoke", "--reps", "1"]) == 0
+    out = capsys.readouterr().out
+    rows = [json.loads(ln) for ln in out.splitlines() if ln.strip()]
+    assert rows and all(r["schema"] == "redist_bench/v1" for r in rows)
+    assert all(r["match"] for r in rows)

@@ -1,0 +1,121 @@
+"""The redist_path knob in the tuning subsystem (ISSUE 12): registry
+coverage, candidate enumeration rules, 'auto' resolution, and the
+one-a2a-round-vs-k-gather-rounds cost-model term."""
+import jax
+import jax.numpy as jnp
+
+import elemental_tpu as el
+from elemental_tpu import tune
+from elemental_tpu.tune import cost_model
+from elemental_tpu.tune.knobs import (OPS, REDIST_PATHS, TuneContext,
+                                      candidate_configs)
+
+
+def _grid(r, c):
+    return el.Grid(jax.devices()[: r * c], height=r)
+
+
+def _ctx(op, dims, grid_shape):
+    return TuneContext(op=op, dims=dims, dtype="float32",
+                       grid_shape=grid_shape, backend="cpu")
+
+
+def test_knob_registered_on_redistribution_heavy_ops_only():
+    for op in ("cholesky", "lu", "gemm"):
+        assert "redist_path" in OPS[op].knobs, op
+    # ops whose schedules route through TSQR trees / triangular solves
+    # keep their space un-doubled until a direct schedule exists for them
+    for op in ("qr", "trsm", "herk"):
+        assert "redist_path" not in OPS[op].knobs, op
+
+
+def test_knob_values_sync_with_engine():
+    """Every tunable value must be a legal engine route (the engine also
+    accepts 'chain'/'auto' spellings the tuner never emits)."""
+    from elemental_tpu.redist.engine import REDIST_PATHS as ENGINE_PATHS
+    assert REDIST_PATHS == (None, "direct")
+    assert set(REDIST_PATHS) <= set(ENGINE_PATHS)
+
+
+def test_candidates_dead_on_1x1_full_on_2x2():
+    ctx1 = _ctx("cholesky", (64, 64), (1, 1))
+    assert {c.get("redist_path") for c in candidate_configs(ctx1)} == {None}
+    ctx2 = _ctx("cholesky", (64, 64), (2, 2))
+    assert {c.get("redist_path") for c in candidate_configs(ctx2)} \
+        == set(REDIST_PATHS)
+
+
+def test_pinned_value_freezes_the_dimension():
+    ctx = _ctx("lu", (64, 64), (2, 2))
+    cands = candidate_configs(ctx, {"redist_path": "direct"})
+    assert {c["redist_path"] for c in cands} == {"direct"}
+    # pinning None (the driver default) keeps the space un-doubled
+    base = candidate_configs(ctx, {"redist_path": None})
+    assert len(cands) == len(base)
+
+
+def test_auto_resolves_to_a_legal_route():
+    kn = tune.resolve_knobs(
+        "cholesky", gshape=(64, 64), dtype=jnp.float32, grid=_grid(1, 1),
+        knobs={"nb": 16, "lookahead": True, "crossover": 0,
+               "comm_precision": None, "redist_path": "auto"})
+    assert kn["redist_path"] is None          # 1x1: no wire to optimize
+    kn2 = tune.resolve_knobs(
+        "cholesky", gshape=(256, 256), dtype=jnp.float32, grid=_grid(2, 2),
+        knobs={"nb": 64, "lookahead": True, "crossover": 0,
+               "comm_precision": None, "redist_path": "auto"})
+    assert kn2["redist_path"] in REDIST_PATHS
+
+
+def test_gemm_cost_model_swaps_gather_sites_for_one_shot_plans():
+    """For a 'direct' config the closed-form gemm cost replaces each
+    chained operand move with its compiled plan's single collective --
+    alg C's 8 per-panel all_gathers become 8 one-shot all_to_alls (one
+    plan per operand panel; fewer ROUNDS shows up on the multi-hop
+    chains of alg A/B and the traced factorizations)."""
+    ctx = _ctx("gemm", (512, 512, 512), (2, 2))
+    base = cost_model.score_config(
+        "gemm", {"alg": "C", "nb": 128, "comm_precision": None,
+                 "redist_path": None}, ctx=ctx, dtype=jnp.float32)
+    direct = cost_model.score_config(
+        "gemm", {"alg": "C", "nb": 128, "comm_precision": None,
+                 "redist_path": "direct"}, ctx=ctx, dtype=jnp.float32)
+    assert base.prim_counts == {"all_gather": 8}
+    assert direct.prim_counts == {"all_to_all": 8}
+    assert direct.rounds == base.rounds
+
+
+def test_path_none_closed_form_unchanged_by_the_knob_plumbing():
+    """The path-None score must stay byte-identical whether or not the
+    config dict carries the new key (the cost-model pinning tests
+    elsewhere compare against abstract traces)."""
+    ctx = _ctx("gemm", (512, 512, 512), (2, 2))
+    bare = cost_model.score_config(
+        "gemm", {"alg": "C", "nb": 128, "comm_precision": None},
+        ctx=ctx, dtype=jnp.float32)
+    keyed = cost_model.score_config(
+        "gemm", {"alg": "C", "nb": 128, "comm_precision": None,
+                 "redist_path": None}, ctx=ctx, dtype=jnp.float32)
+    assert bare.comm_bytes == keyed.comm_bytes
+    assert bare.rounds == keyed.rounds
+    assert bare.prim_counts == keyed.prim_counts
+
+
+def test_traced_lu_direct_prices_the_real_one_shot_schedule():
+    """lu/cholesky price 'direct' by re-tracing the ACTUAL schedule with
+    the knob threaded through -- the gather hops disappear from the
+    prim mix in favor of one-shot all_to_alls."""
+    g2 = _grid(2, 2)
+    ctx = _ctx("lu", (64, 64), (2, 2))
+    cfg = {"nb": 16, "lookahead": True, "crossover": 0, "panel": "classic",
+           "comm_precision": None}
+    base = cost_model.score_config(
+        "lu", dict(cfg, redist_path=None), ctx=ctx, grid=g2,
+        dtype=jnp.float32)
+    direct = cost_model.score_config(
+        "lu", dict(cfg, redist_path="direct"), ctx=ctx, grid=g2,
+        dtype=jnp.float32)
+    assert base.prim_counts.get("all_gather", 0) > 0
+    assert direct.prim_counts.get("all_gather", 0) == 0
+    assert direct.prim_counts.get("all_to_all", 0) \
+        > base.prim_counts.get("all_to_all", 0)

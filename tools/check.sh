@@ -38,6 +38,13 @@
 #                             #   panel granularity, recompute count == 1)
 #                             #   + the *_abft comm-plan golden diff +
 #                             #   tests/resilience/test_abft.py
+#   tools/check.sh redist     # one-shot redistribution gate (ISSUE 12):
+#                             #   plan-compiler unit + direct-vs-chain
+#                             #   bit-equivalence tests, the *_direct
+#                             #   comm-plan golden diff (strict round
+#                             #   wins pinned), the redist_path knob
+#                             #   tests, the EL002 rewrite-hint smoke,
+#                             #   and redist_bench --smoke
 set -u
 cd "$(dirname "$0")/.."
 
@@ -150,6 +157,23 @@ if [ "$what" = "all" ] || [ "$what" = "abft" ]; then
     JAX_PLATFORMS=cpu python -m perf.comm_audit diff cholesky_abft || rc=1
     echo "== abft tier-1 tests (detection/recovery acceptance matrix) =="
     python -m pytest tests/resilience/test_abft.py -q -m 'not slow' -p no:cacheprovider || rc=1
+fi
+
+if [ "$what" = "all" ] || [ "$what" = "redist" ]; then
+    echo "== one-shot plan compiler + direct-vs-chain equivalence tests =="
+    python -m pytest tests/core/test_redist_direct.py \
+        tests/analysis/test_direct_plan.py \
+        tests/tune/test_redist_path_knob.py \
+        -q -m 'not slow' -p no:cacheprovider || rc=1
+    echo "== *_direct comm-plan goldens (one-shot round wins, 1x1 + 2x2) =="
+    JAX_PLATFORMS=cpu python -m perf.comm_audit diff gemm_a_direct || rc=1
+    JAX_PLATFORMS=cpu python -m perf.comm_audit diff gemm_b_direct || rc=1
+    JAX_PLATFORMS=cpu python -m perf.comm_audit diff gemm_dot_direct || rc=1
+    echo "== EL002 rewrite-hint smoke (lint --fix-hint accepted, clean) =="
+    JAX_PLATFORMS=cpu python -m perf.comm_audit lint gemm --fix-hint || rc=1
+    echo "== redist_bench smoke (1x1, chain-vs-direct bit-match) =="
+    JAX_PLATFORMS=cpu python -m perf.redist_bench --smoke --reps 1 \
+        > /dev/null || rc=1
 fi
 
 if [ "$what" = "all" ] || [ "$what" = "serve" ]; then
