@@ -229,7 +229,8 @@ def main():
     # above use the pinned nb/panel for baseline comparability.)
     tuner: dict = {"ran_with": {"nb": nb, "lookahead": True,
                                 "crossover": None, "panel": "classic",
-                                "comm_precision": None}}
+                                "comm_precision": None,
+                                "redist_path": None}}
     try:
         from elemental_tpu import tune as el_tune
         for op, nn in (("cholesky", n_chol), ("lu", n_lu)):
@@ -237,8 +238,14 @@ def main():
             # this single-chip grid 'auto' resolves to None (the knob is
             # dead without collectives); a multi-device bench records the
             # tuner's wire-precision pick here next to nb/panel
+            # redist_path joins the provenance (ISSUE 12/13): 'auto'
+            # resolves chain vs one-shot per grid -- None on single-chip
+            # (every plan is 'local'), and a multi-chip bench records the
+            # arbiter's pick (measured constants when recorded, the ring
+            # model otherwise) next to nb/panel
             requested = {"nb": "auto", "lookahead": "auto",
-                         "crossover": "auto", "comm_precision": "auto"}
+                         "crossover": "auto", "comm_precision": "auto",
+                         "redist_path": "auto"}
             if op == "lu":
                 requested["panel"] = "auto"
             res = el_tune.resolve(

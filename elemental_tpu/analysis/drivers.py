@@ -86,27 +86,73 @@ def _gemm_spec(alg, variant="", redist_path=None):
     return DriverSpec(f"{name}_{variant}" if variant else name, build)
 
 
-def _trsm_spec():
+def _trsm_spec(variant="", side="L", redist_path=None):
     def build(grid, n, nb, dtype):
         from ..blas.level3 import trsm
 
         def fn(a, b):
             A = _as_dm(a, grid, n, n)
             B = _as_dm(b, grid, n, n)
-            return trsm("L", "L", "N", A, B, nb=nb)
+            return trsm(side, "L", "N", A, B, nb=nb,
+                        redist_path=redist_path)
         args = (_mcmr_input(grid, n, n, dtype), _mcmr_input(grid, n, n, dtype))
-        return fn, args, {}
-    return DriverSpec("trsm", build)
+        meta = {}
+        if side != "L":
+            meta["side"] = side
+        if redist_path is not None:
+            meta["redist_path"] = redist_path
+        return fn, args, meta
+    return DriverSpec(f"trsm_{variant}" if variant else "trsm", build)
 
 
-def _herk_spec():
+def _herk_spec(variant="", redist_path=None):
     def build(grid, n, nb, dtype):
         from ..blas.level3 import herk
 
         def fn(a):
-            return herk("L", _as_dm(a, grid, n, n), nb=nb)
-        return fn, (_mcmr_input(grid, n, n, dtype),), {}
-    return DriverSpec("herk", build)
+            return herk("L", _as_dm(a, grid, n, n), nb=nb,
+                        redist_path=redist_path)
+        meta = {}
+        if redist_path is not None:
+            meta["redist_path"] = redist_path
+        return fn, (_mcmr_input(grid, n, n, dtype),), meta
+    return DriverSpec(f"herk_{variant}" if variant else "herk", build)
+
+
+def _lq_spec(variant="", redist_path=None):
+    def build(grid, n, nb, dtype):
+        from ..lapack.qr import lq
+
+        def fn(a):
+            return lq(_as_dm(a, grid, n, n), nb=nb, redist_path=redist_path)
+        meta = {}
+        if redist_path is not None:
+            meta["redist_path"] = redist_path
+        return fn, (_mcmr_input(grid, n, n, dtype),), meta
+    return DriverSpec(f"qr_lq_{variant}" if variant else "qr_lq", build)
+
+
+def _redist_md_spec(variant="", redist_path=None):
+    """[MC,MR] -> [MD,STAR] -> [STAR,MD] round-trip at RAGGED extents
+    ((n-1, n-3): the diagonal locals straddle slot boundaries), the
+    incompatible-residue pair whose one-shot plan exercises both ragged
+    slot trimming and subgroup packing (ISSUE 13)."""
+    def build(grid, n, nb, dtype):
+        from ..core.dist import Dist
+        from ..redist.engine import redistribute
+        MD, STAR = Dist.MD, Dist.STAR
+        m_, n_ = n - 1, n - 3
+
+        def fn(a):
+            A = _as_dm(a, grid, m_, n_)
+            B = redistribute(A, MD, STAR, path=redist_path)
+            return redistribute(B, STAR, MD, path=redist_path)
+        meta = {"extents": [m_, n_]}
+        if redist_path is not None:
+            meta["redist_path"] = redist_path
+        return fn, (_mcmr_input(grid, m_, n_, dtype),), meta
+    return DriverSpec(f"redist_md_{variant}" if variant else "redist_md",
+                      build)
 
 
 def _cholesky_spec(variant, lookahead, crossover, comm_precision=None,
@@ -201,6 +247,22 @@ def _registry() -> dict:
         _gemm_spec("A", variant="direct", redist_path="direct"),
         _gemm_spec("B", variant="direct", redist_path="direct"),
         _gemm_spec("dot", variant="direct", redist_path="direct"),
+        # ISSUE 13: every remaining driver family gets a one-shot twin.
+        # qr's own panel gathers are already single-round, so the lq
+        # entry transpose (a 3-hop chain) carries the qr-family pin;
+        # trsm's win is the side='R' entry/exit transposes; herk's is the
+        # per-panel [VC,STAR]+spread pair collapsing into ONE exchange.
+        _lq_spec(),
+        _lq_spec(variant="direct", redist_path="direct"),
+        _trsm_spec(variant="r", side="R"),
+        _trsm_spec(variant="r_direct", side="R", redist_path="direct"),
+        _herk_spec(variant="direct", redist_path="direct"),
+        # ragged [MD,*] round-trip: equal round counts chain vs direct,
+        # so NOT in DIRECT_PAIRS -- its golden pins the ragged-slot BYTE
+        # drop instead (trimmed slots + subgroup packing vs the padded
+        # full-mesh exchange; see tests/analysis/test_direct_plan.py)
+        _redist_md_spec(),
+        _redist_md_spec(variant="direct", redist_path="direct"),
     ]
     return {s.name: s for s in specs}
 
@@ -246,6 +308,11 @@ DIRECT_PAIRS = (
     ("gemm_a_direct", "gemm_a"),
     ("gemm_b_direct", "gemm_b"),
     ("gemm_dot_direct", "gemm_dot"),
+    # ISSUE 13: the qr/trsm/herk one-shot twins (redist_md is pinned on
+    # bytes, not rounds -- its chain and direct round counts tie)
+    ("qr_lq_direct", "qr_lq"),
+    ("trsm_r_direct", "trsm_r"),
+    ("herk_direct", "herk"),
 )
 
 

@@ -316,7 +316,8 @@ def trrk(uplo: str, alpha, A_mc: DistMatrix, B_mr: DistMatrix, beta, C: DistMatr
 
 def herk(uplo: str, A: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None = None,
          orient: str = "N", nb: int | str | None = None, precision=None,
-         conj: bool = True, comm_precision: str | None = None) -> DistMatrix:
+         conj: bool = True, comm_precision: str | None = None,
+         redist_path: str | None = None) -> DistMatrix:
     """C(tri) := alpha op(A) op(A)^H + beta C(tri)  (orient 'N' or 'C'/'T').
 
     Per k-panel: A1 -> [VC,STAR], then the fused engine ``panel_spread``
@@ -325,15 +326,20 @@ def herk(uplo: str, A: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None = N
     ``cholesky::LVar3``); masked local update.  ``nb='auto'`` asks the
     tuning subsystem for the k-panel width.  ``comm_precision`` selects
     the wire precision of the panel move + spread (see :func:`gemm`).
+    ``redist_path='direct'`` replaces the [VC,STAR] hop + spread (two
+    rounds per panel) with one one-shot [MC,MR] -> [STAR,STAR] exchange
+    followed by zero-round local filters.
     """
     if orient != "N":
         A = _orient(A, "C" if conj else "T")
     _check_mcmr(A)
     m, k = A.gshape
-    if isinstance(nb, str) or comm_precision == "auto":
+    if isinstance(nb, str) or comm_precision == "auto" or redist_path == "auto":
         kn = _resolve_auto("herk", (m, k), A.dtype, A.grid, nb=nb,
-                           comm_precision=comm_precision)
+                           comm_precision=comm_precision,
+                           redist_path=redist_path)
         nb, comm_precision = kn["nb"], kn["comm_precision"]
+        redist_path = kn.get("redist_path")
     from ..redist.quantize import check_comm_precision
     check_comm_precision(comm_precision)
     r, c = A.grid.height, A.grid.width
@@ -351,10 +357,19 @@ def herk(uplo: str, A: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None = N
     acc = beta * C.local if _nonzero(beta) else jnp.zeros_like(C.local)
     for i, s in enumerate(range(0, k, kb)):
         e = min(s + kb, k)
-        A1_vc = redistribute(view(A, cols=(s, e)), VC, STAR,
-                             comm_precision=comm_precision)
-        A1_mc, A1H_mr = panel_spread(A1_vc, conj=conj,
-                                     comm_precision=comm_precision)
+        if redist_path == "direct":
+            # One one-shot exchange per panel; the [MC,STAR] panel and its
+            # [STAR,MR] adjoint are then zero-round local filters.
+            A1_ss = redistribute(view(A, cols=(s, e)), STAR, STAR,
+                                 comm_precision=comm_precision, path="direct")
+            A1_mc = redistribute(A1_ss, MC, STAR)
+            A1H_mr = redistribute(transpose_dist(A1_ss, conj=conj), STAR, MR)
+        else:
+            A1_vc = redistribute(view(A, cols=(s, e)), VC, STAR,
+                                 comm_precision=comm_precision,
+                                 path=redist_path)
+            A1_mc, A1H_mr = panel_spread(A1_vc, conj=conj,
+                                         comm_precision=comm_precision)
         tm.tick("spread", i, A1_mc.local, A1H_mr.local)
         acc = acc + alpha * jnp.matmul(A1_mc.local, A1H_mr.local, precision=precision)
         tm.tick("update", i, acc)
@@ -373,7 +388,8 @@ def syrk(uplo: str, A: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None = N
 
 def trsm(side: str, uplo: str, orient: str, A: DistMatrix, B: DistMatrix,
          alpha=1.0, unit: bool = False, nb: int | str | None = None,
-         precision=None, comm_precision: str | None = None) -> DistMatrix:
+         precision=None, comm_precision: str | None = None,
+         redist_path: str | None = None) -> DistMatrix:
     """Solve op(A) X = alpha B (side 'L') or X op(A) = alpha B (side 'R');
     A triangular [MC,MR].  Reference: ``El::Trsm`` 8 side/uplo/orientation
     cases (``src/blas_like/level3/Trsm/*.hpp``).
@@ -383,11 +399,17 @@ def trsm(side: str, uplo: str, orient: str, A: DistMatrix, B: DistMatrix,
     transposed system (X op(A) = B  <=>  op(A)^T X^T = B^T).
     ``comm_precision`` selects the wire precision of the panel moves
     (diagonal-block gathers, RHS panel transport, off-diagonal operand
-    moves; see :func:`gemm`)."""
-    if isinstance(nb, str) or comm_precision == "auto":
+    moves; see :func:`gemm`).  ``redist_path`` routes those moves through
+    the one-shot plan compiler ('direct'), the hop chain ('chain'/None),
+    or measured-constant arbitration ('auto'); right-side solves benefit
+    most (the entry/exit transposes collapse from 3-hop chains to one
+    exchange each)."""
+    if isinstance(nb, str) or comm_precision == "auto" or redist_path == "auto":
         kn = _resolve_auto("trsm", B.gshape, B.dtype, B.grid, nb=nb,
-                           comm_precision=comm_precision)
+                           comm_precision=comm_precision,
+                           redist_path=redist_path)
         nb, comm_precision = kn["nb"], kn["comm_precision"]
+        redist_path = kn.get("redist_path")
     from ..redist.quantize import check_comm_precision
     check_comm_precision(comm_precision)
     tm = _phase_hook("trsm")
@@ -395,18 +417,18 @@ def trsm(side: str, uplo: str, orient: str, A: DistMatrix, B: DistMatrix,
     trans = orient in ("T", "C")
     conj = orient == "C"
     if side.upper().startswith("R"):
-        BT = redistribute(transpose_dist(B), MC, MR)
+        BT = redistribute(transpose_dist(B), MC, MR, path=redist_path)
         # op(A)^T: N -> T; T -> N; C -> conj-only (trans=False, conj=True)
         XT = _trsm_left(uplo, not trans, conj, A, BT, alpha, unit, nb,
-                        precision, tm, comm_precision)
-        return redistribute(transpose_dist(XT), MC, MR)
+                        precision, tm, comm_precision, redist_path)
+        return redistribute(transpose_dist(XT), MC, MR, path=redist_path)
     return _trsm_left(uplo, trans, conj, A, B, alpha, unit, nb, precision,
-                      tm, comm_precision)
+                      tm, comm_precision, redist_path)
 
 
 def _trsm_left(uplo: str, trans: bool, conj: bool, A: DistMatrix, B: DistMatrix,
                alpha, unit: bool, nb: int | None, precision,
-               tm=_NULL_HOOK, cp=None) -> DistMatrix:
+               tm=_NULL_HOOK, cp=None, rp=None) -> DistMatrix:
     """All eight left cases.  Effective triangle: uplo XOR trans decides the
     sweep direction; per panel the diagonal block is replicated
     ([STAR,STAR]), the RHS panel goes 1-D cyclic ([STAR,VR]) for the local
@@ -427,16 +449,17 @@ def _trsm_left(uplo: str, trans: bool, conj: bool, A: DistMatrix, B: DistMatrix,
     for k, s in enumerate(starts):
         e = min(s + ib, m)
         A11 = redistribute(view(A, rows=(s, e), cols=(s, e)), STAR, STAR,
-                           comm_precision=cp)
+                           comm_precision=cp, path=rp)
         # mask to the stored triangle so opposite-triangle garbage (e.g. the
         # packed L\U format of lu()) can never leak into the solve
         a11 = jnp.tril(A11.local) if lower else jnp.triu(A11.local)
-        B1 = redistribute(view(X, rows=(s, e)), STAR, VR, comm_precision=cp)
+        B1 = redistribute(view(X, rows=(s, e)), STAR, VR, comm_precision=cp,
+                          path=rp)
         x1 = lax.linalg.triangular_solve(
             a11, B1.local, left_side=True, lower=lower,
             transpose_a=trans, conjugate_a=conj, unit_diagonal=unit)
         X1 = DistMatrix(x1, B1.gshape, STAR, VR, 0, 0, A.grid)
-        X1_mr = redistribute(X1, STAR, MR, comm_precision=cp)
+        X1_mr = redistribute(X1, STAR, MR, comm_precision=cp, path=rp)
         X = update_view(X, redistribute(X1_mr, MC, MR), rows=(s, e))  # local filter
         tm.tick("solve", k, X.local)
         # trailing update of the not-yet-solved rows
@@ -446,11 +469,11 @@ def _trsm_left(uplo: str, trans: bool, conj: bool, A: DistMatrix, B: DistMatrix,
         if trans:
             # T21 = op(A)[hi-part, s:e] = op(A[s:e, hi-part])
             A1p = redistribute(view(A, rows=(s, e), cols=(lo, hi)), STAR, MC,
-                               comm_precision=cp)
+                               comm_precision=cp, path=rp)
             a_loc = A1p.local.T            # [MC,STAR]-storage of A1p^T
         else:
             A1p = redistribute(view(A, rows=(lo, hi), cols=(s, e)), MC, STAR,
-                               comm_precision=cp)
+                               comm_precision=cp, path=rp)
             a_loc = A1p.local
         if conj:
             a_loc = jnp.conj(a_loc)

@@ -195,7 +195,7 @@ def _panel_qr_tsqr(P, r: int, precision=None):
 
 def qr(A: DistMatrix, nb: int | str | None = None, precision=None,
        panel: str = "classic", comm_precision: str | None = None,
-       timer=None, health=None):
+       timer=None, health=None, redist_path: str | None = None):
     """Blocked Householder QR; returns (packed, tau) in geqrf format.
 
     ``nb='auto'`` asks the tuning subsystem for the panel width.  The
@@ -224,6 +224,11 @@ def qr(A: DistMatrix, nb: int | str | None = None, precision=None,
     Opt-in; ``None`` (default) is bit-identical.  See the README's
     "Quantized collectives" section for the accuracy trade.
 
+    ``redist_path`` (``None`` | ``'chain'`` | ``'direct'`` | ``'auto'``)
+    routes the panel gathers through the one-shot plan compiler instead
+    of the hop chain; ``'auto'`` arbitrates per move with the measured
+    redist constants when recorded (see :mod:`perf.redist_bench`).
+
     ``health`` opts into the resilience subsystem's numerical-health
     guards, with the same contract as ``lu``/``cholesky`` (ISSUE 7 gap
     closed in ISSUE 9): pass a ``HealthMonitor`` (read
@@ -237,13 +242,16 @@ def qr(A: DistMatrix, nb: int | str | None = None, precision=None,
     _check_mcmr(A)
     m, n = A.gshape
     g = A.grid
-    if isinstance(nb, str) or panel == "auto" or comm_precision == "auto":
+    if isinstance(nb, str) or panel == "auto" or comm_precision == "auto" \
+            or redist_path == "auto":
         from ..tune.policy import resolve_knobs
         kn = resolve_knobs("qr", gshape=A.gshape, dtype=A.dtype, grid=g,
                            knobs={"nb": nb, "panel": panel,
-                                  "comm_precision": comm_precision})
+                                  "comm_precision": comm_precision,
+                                  "redist_path": redist_path})
         nb, panel, comm_precision = kn["nb"], kn["panel"], \
             kn["comm_precision"]
+        redist_path = kn.get("redist_path")
     from ..redist.quantize import check_comm_precision
     check_comm_precision(comm_precision)
     if panel is None:
@@ -267,7 +275,8 @@ def qr(A: DistMatrix, nb: int | str | None = None, precision=None,
         e_up = min(-(-e // c) * c, n)
         panel_ss = redistribute(view(A, rows=(s, m), cols=(s, e_up)),
                                 STAR, STAR,
-                                comm_precision=comm_precision)
+                                comm_precision=comm_precision,
+                                path=redist_path)
         if panel == "tsqr":
             Pf, tau = _panel_qr_tsqr(panel_ss.local[:, :nbw], r, precision)
         else:
@@ -553,15 +562,18 @@ def _complement(jpvt, n: int):
 # LQ (via the QR of the adjoint)
 # ---------------------------------------------------------------------
 
-def lq(A: DistMatrix, nb: int | None = None, precision=None):
+def lq(A: DistMatrix, nb: int | None = None, precision=None,
+       redist_path: str | None = None):
     """LQ factorization ``A = L Q`` with L lower-trapezoidal and Q having
     orthonormal rows (``El::LQ``): computed as the QR of ``A^H``
     (``A^H = Q_r R  =>  A = R^H Q_r^H``).  Returns ``(packed, tau)`` where
     ``packed`` is the geqrf-packed QR of ``A^H`` ((n, m)-shaped); use
-    :func:`apply_q_lq` / :func:`explicit_l` to consume it."""
+    :func:`apply_q_lq` / :func:`explicit_l` to consume it.
+    ``redist_path='direct'`` collapses the entry transpose-exchange from a
+    3-hop chain to one one-shot exchange and rides the QR panel gathers."""
     from ..redist.engine import transpose_dist
-    Ah = redistribute(transpose_dist(A, conj=True), MC, MR)
-    return qr(Ah, nb=nb, precision=_hi(precision))
+    Ah = redistribute(transpose_dist(A, conj=True), MC, MR, path=redist_path)
+    return qr(Ah, nb=nb, precision=_hi(precision), redist_path=redist_path)
 
 
 def apply_q_lq(Ap: DistMatrix, tau, B: DistMatrix, orient: str = "N",

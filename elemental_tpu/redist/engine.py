@@ -119,6 +119,12 @@ class RedistRecord:
     #: (-1 = not computed); with ``rounds`` this is the "per-round wire
     #: bytes" record of the chosen path
     wire_bytes: int = -1
+    #: why a ``path='direct'|'auto'`` request resolved to the chain
+    #: ("" = it did not fall back): "noop" (src == dst at equal aligns),
+    #: "no_plan" (compile_plan returned None), or "arbitration" (the
+    #: measured/ring cost model preferred the chain under 'auto').
+    #: Mirrored into the ``redist_fallbacks`` obs counter.
+    fallback_reason: str = ""
     # live references keep the ids above unambiguous (no id reuse after GC)
     refs: tuple = dataclasses.field(default=(), repr=False, compare=False)
 
@@ -234,7 +240,7 @@ def apply_fault(target: str, outputs: tuple) -> tuple:
 
 def _trace_record(kind, src, dst, gshape, dtype, objs_in, objs_out,
                   grid_shape=(), wire_dtype=None, path="chain", rounds=-1,
-                  wire_bytes=-1, observers_only=False):
+                  wire_bytes=-1, fallback_reason="", observers_only=False):
     """Build + publish one RedistRecord.  ``observers_only`` skips the
     ``redist_trace`` list (used by the row-permute fast path: the obs
     tracer must see its wire traffic, but the comm-plan goldens aggregate
@@ -247,7 +253,7 @@ def _trace_record(kind, src, dst, gshape, dtype, objs_in, objs_out,
         dtype=str(dtype), in_id=id(objs_in),
         out_ids=tuple(id(o) for o in objs_out), grid_shape=tuple(grid_shape),
         wire_dtype=str(wire_dtype or dtype), path=path, rounds=rounds,
-        wire_bytes=wire_bytes,
+        wire_bytes=wire_bytes, fallback_reason=fallback_reason,
         refs=(objs_in,) + tuple(objs_out))
     if _REDIST_TRACE is not None and not observers_only:
         _REDIST_TRACE.append(rec)
@@ -271,6 +277,8 @@ def _pad_dim(x, dim: int, target: int):
 def _gather_dim(x, dim: int, d: Dist, align: int, extent: int, r: int, c: int):
     """Rebuild the full (true-extent) dimension on every device."""
     if d is MD:
+        if r * c == 1:
+            return lax.slice_in_dim(x, 0, extent, axis=dim)
         # p slot-ranges of length l gathered mc-major, then the static
         # slot permutation rebuilds global order (copy:: for [MD,*])
         g = lax.all_gather(x, ("mc", "mr"), axis=0)       # (p, l, ...)
@@ -323,6 +331,8 @@ def _partial_gather_dim(x, dim: int, axes, nblocks: int, l_out: int):
     coarse rank gather their fine-grained cyclic blocks; interleaving them
     yields the coarse-cyclic local block.
     """
+    if nblocks == 1:                    # degenerate: nothing to exchange
+        return lax.slice_in_dim(x, 0, l_out, axis=dim)
     g = lax.all_gather(x, axes, axis=0)                   # (nblocks, l_in, ...)
     g = jnp.moveaxis(g, 0, dim + 1)
     shape = list(x.shape)
@@ -364,7 +374,8 @@ def _fused_to_v(A: DistMatrix) -> DistMatrix:
     x = _pad_dim(A.local, 0, n_other * lt)
     lc = x.shape[1]
     x3 = x.reshape(lt, n_other, lc)         # row t = w*n_other + g
-    y = lax.all_to_all(x3, ax, split_axis=1, concat_axis=1)
+    y = x3 if n_other == 1 \
+        else lax.all_to_all(x3, ax, split_axis=1, concat_axis=1)
     z = jnp.moveaxis(y, 1, 2).reshape(lt, lc * n_other)
     z = lax.slice_in_dim(z, 0, n, axis=1)
     v = rank_of(dst, r, c)
@@ -390,7 +401,8 @@ def _fused_from_v(A: DistMatrix) -> DistMatrix:
     lcd = ix.max_local_length(n, n_other)
     x = _pad_dim(A.local, 1, n_other * lcd)
     x3 = x.reshape(lp, lcd, n_other)        # col j = u*n_other + s
-    y = lax.all_to_all(x3, ax, split_axis=2, concat_axis=2)
+    y = x3 if n_other == 1 \
+        else lax.all_to_all(x3, ax, split_axis=2, concat_axis=2)
     z = jnp.moveaxis(y, 2, 1).reshape(lp * n_other, lcd)
     lr = ix.max_local_length(m, S_row)
     z = lax.slice_in_dim(z, 0, lr, axis=0)
@@ -855,20 +867,33 @@ def chain_cost(src, dst, gshape, grid_shape, itemsize):
 
 def direct_plan_for(A: DistMatrix, cdist: Dist, rdist: Dist,
                     calign: int = 0, ralign: int = 0):
-    """The compiled one-shot plan for this redistribution, or None when
-    no plan applies (alignment, MD/CIRC, or a no-op)."""
-    if (calign, ralign) != (0, 0) or not _zero_aligned(A):
-        return None
+    """The compiled one-shot plan for this redistribution (alignments
+    included since phase 2), or None when no plan applies (a no-op, or
+    an MD endpoint at nonzero alignments -- which ``to_dist`` rejects)."""
     return compile_plan(A.dist, (cdist, rdist), A.gshape,
-                        (A.grid.height, A.grid.width))
+                        (A.grid.height, A.grid.width),
+                        (A.calign, A.ralign), (calign, ralign))
 
 
-def _machine_terms():
-    """(latency_s, bw_bytes_per_s) for the running backend; safe TPU-ish
-    defaults when the tune subsystem is unavailable."""
+def _machine_terms(grid_shape=None):
+    """(latency_s, bw_bytes_per_s) for the running backend.
+
+    Measured ``redist_constants/v1`` recorded by ``perf.redist_bench
+    --record`` for this (grid, backend) take precedence over the static
+    :mod:`..tune.cost_model` ring model; safe TPU-ish defaults when the
+    tune subsystem is unavailable."""
+    backend = jax.default_backend()
+    if grid_shape is not None:
+        try:
+            from ..tune.cache import load_redist_constants
+            doc = load_redist_constants(tuple(grid_shape), backend)
+        except Exception:
+            doc = None
+        if doc is not None:
+            return float(doc["alpha_s"]), float(doc["bw_bytes_per_s"])
     try:
         from ..tune.cost_model import machine_for
-        mm = machine_for(jax.default_backend())
+        mm = machine_for(backend)
         return mm.latency_s, mm.bw_bytes_per_s
     except Exception:
         return 2e-6, 4.5e10
@@ -877,12 +902,14 @@ def _machine_terms():
 def _direct_wins(plan, gshape, itemsize) -> bool:
     """``path='auto'`` arbitration: alpha-beta (latency x rounds +
     bytes / bandwidth) comparison of the one-shot plan against the
-    chained route; ties go to the chain (the bit-identical default)."""
+    chained route, using the measured per-(grid, backend) constants when
+    ``redist_bench --record`` has written them; ties go to the chain
+    (the bit-identical default)."""
     rounds_c, bytes_c = chain_cost(plan.src, plan.dst, gshape,
                                    plan.grid_shape, itemsize)
     if rounds_c == 0:
         return False
-    lat, bw = _machine_terms()
+    lat, bw = _machine_terms(plan.grid_shape)
     t_direct = lat * plan.rounds + plan.wire_bytes(itemsize) / bw
     t_chain = lat * rounds_c + bytes_c / bw
     return t_direct < t_chain
@@ -913,8 +940,12 @@ def _direct_exec(x, plan, wire, dt):
     if q8:
         vals = jax.vmap(lambda s: q8_pack(s, QUANT_TILE))(vals)
     if plan.kind == "a2a":
+        # ragged subgroup a2a: the plan's equal-size participant groups
+        # (or None for the full comm product); the K* slots are addressed
+        # by GROUP position, which the remapped index tables encode
+        gg = [list(g) for g in plan.groups] if plan.groups else None
         recv = lax.all_to_all(vals, plan.comm_axes, split_axis=0,
-                              concat_axis=0)
+                              concat_axis=0, axis_index_groups=gg)
     elif plan.kind == "ppermute":
         recv = lax.ppermute(vals, plan.comm_axes, list(plan.perm))
     else:
@@ -927,12 +958,15 @@ def _direct_exec(x, plan, wire, dt):
     return out.at[rr[:, :, None], rc[:, None, :]].set(recv, mode="drop")
 
 
-@partial(jax.jit, static_argnums=(1, 2, 3))
+@partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
 def _redistribute_direct_jit(A: DistMatrix, cdist: Dist, rdist: Dist,
+                             calign: int = 0, ralign: int = 0,
                              wire=None) -> DistMatrix:
     plan = compile_plan(A.dist, (cdist, rdist), A.gshape,
-                        (A.grid.height, A.grid.width))
-    out_meta = DistMatrix(None, A.gshape, cdist, rdist, 0, 0, A.grid)
+                        (A.grid.height, A.grid.width),
+                        (A.calign, A.ralign), (calign, ralign))
+    out_meta = DistMatrix(None, A.gshape, cdist, rdist, calign, ralign,
+                          A.grid)
     dt = A.dtype
 
     def f(a):
@@ -941,7 +975,8 @@ def _redistribute_direct_jit(A: DistMatrix, cdist: Dist, rdist: Dist,
             x = x.astype(jnp.bfloat16)
         loc = _direct_exec(x, plan, wire, dt)
         loc = loc.astype(dt)
-        return DistMatrix(loc, A.gshape, cdist, rdist, 0, 0, A.grid)
+        return DistMatrix(loc, A.gshape, cdist, rdist, calign, ralign,
+                          A.grid)
 
     return shard_map(
         f, mesh=A.grid.mesh, in_specs=(A.spec,), out_specs=out_meta.spec,
@@ -1334,17 +1369,22 @@ def redistribute(A: DistMatrix, cdist: Dist, rdist: Dist,
     bit-identical full-precision path; the knob is a no-op on 1x1 grids,
     non-real-float payloads, and replicated sources (pure-local filters).
 
-    ``path`` (see :data:`REDIST_PATHS`, ISSUE 12) selects the route:
+    ``path`` (see :data:`REDIST_PATHS`, ISSUE 12/13) selects the route:
     ``None``/``'chain'`` run the factored multi-hop dispatch (bit-identical
     to the historical engine); ``'direct'`` executes the ONE-SHOT compiled
-    plan (:mod:`.plan` -- a single all_to_all/ppermute with static
-    gather/scatter index maps) whenever one compiles, falling back to the
-    chain otherwise (alignments, MD/CIRC endpoints); ``'auto'`` compiles
-    the plan and takes it only where the ring-model alpha-beta cost says
-    it beats the chain (ties go to the chain).  On the direct route an
-    ``'int8'`` ``comm_precision`` block-scale-packs every plan slot, so
-    the narrow payload rides ANY pair's single collective -- not just the
-    gather-to-[STAR,STAR] family.
+    plan (:mod:`.plan` -- a single all_to_all/ppermute with static ragged
+    gather/scatter index maps), which since phase 2 covers every legal
+    pair at every legal alignment (MD included; CIRC endpoints compile to
+    a costed bridge executed on the eager root path), falling back to the
+    chain only for no-ops; ``'auto'`` compiles the plan and takes it only
+    where the alpha-beta cost -- measured ``redist_constants/v1`` when
+    ``perf.redist_bench --record`` has written them for this (grid,
+    backend), the static ring model otherwise -- says it beats the chain
+    (ties go to the chain).  Fallbacks increment the ``redist_fallbacks``
+    obs counter and stamp ``RedistRecord.fallback_reason``.  On the
+    direct route an ``'int8'`` ``comm_precision`` block-scale-packs every
+    plan slot, so the narrow payload rides ANY pair's single collective
+    -- not just the gather-to-[STAR,STAR] family.
 
     CIRC conversions (root-only storage) run EAGERLY at this edge via the
     global bridges plus cross-device ``device_put`` (copy::Gather /
@@ -1358,15 +1398,25 @@ def redistribute(A: DistMatrix, cdist: Dist, rdist: Dist,
     noop = A.dist == (cdist, rdist) \
         and (A.calign, A.ralign) == (calign, ralign)
     plan = None
-    if path in ("direct", "auto") and not circ and not noop:
-        plan = direct_plan_for(A, cdist, rdist, calign, ralign)
-        if plan is not None and path == "auto" and \
-                not _direct_wins(plan, A.gshape, jnp.dtype(A.dtype).itemsize):
-            plan = None
-    if plan is not None:
+    fallback_reason = ""
+    if path in ("direct", "auto"):
+        if noop:
+            fallback_reason = "noop"
+        else:
+            plan = direct_plan_for(A, cdist, rdist, calign, ralign)
+            if plan is None:
+                fallback_reason = "no_plan"
+            elif path == "auto" and plan.kind != "bridge" and \
+                    not _direct_wins(plan, A.gshape,
+                                     jnp.dtype(A.dtype).itemsize):
+                plan, fallback_reason = None, "arbitration"
+    if fallback_reason:
+        from ..obs import metrics as _metrics
+        _metrics.inc("redist_fallbacks", reason=fallback_reason)
+    if plan is not None and not circ:
         wire = None if plan.kind == "local" \
             else _wire_mode(A, comm_precision, q8_ok=True)
-        out = _redistribute_direct_jit(A, cdist, rdist, wire)
+        out = _redistribute_direct_jit(A, cdist, rdist, calign, ralign, wire)
         if _FAULT_INJECTOR is not None:
             out = out.with_local(
                 _FAULT_INJECTOR.apply("redistribute", (out.local,))[0])
@@ -1392,6 +1442,17 @@ def redistribute(A: DistMatrix, cdist: Dist, rdist: Dist,
     if _FAULT_INJECTOR is not None:
         out = out.with_local(
             _FAULT_INJECTOR.apply("redistribute", (out.local,))[0])
+    if plan is not None:
+        # CIRC bridge under 'direct'/'auto': executed by the eager root
+        # path above, recorded as the direct route with the plan's
+        # honest full-matrix cost (arbitration does not apply -- the
+        # chain route IS the same eager bridge)
+        _trace_record("redistribute", A.dist, (cdist, rdist), A.gshape,
+                      A.dtype, A.local, (out.local,), grid_shape=grid_shape,
+                      wire_dtype=_WIRE_DTYPES.get(wire), path="direct",
+                      rounds=plan.rounds,
+                      wire_bytes=plan.wire_bytes(jnp.dtype(A.dtype).itemsize))
+        return out
     rounds = wire_bytes = -1
     if not circ and not noop and _zero_aligned(A) and (calign, ralign) == (0, 0):
         wire_sz = {"bf16": 2, "int8": 1}.get(wire, jnp.dtype(A.dtype).itemsize)
@@ -1401,7 +1462,8 @@ def redistribute(A: DistMatrix, cdist: Dist, rdist: Dist,
                   A.dtype, A.local, (out.local,),
                   grid_shape=grid_shape,
                   wire_dtype=_WIRE_DTYPES.get(wire), path="chain",
-                  rounds=rounds, wire_bytes=wire_bytes)
+                  rounds=rounds, wire_bytes=wire_bytes,
+                  fallback_reason=fallback_reason)
     return out
 
 

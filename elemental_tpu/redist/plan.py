@@ -34,8 +34,19 @@ chain-vs-direct arbitration lives with the caller/tuner).  The emitted
 index maps are dense ``(p, K, R)``/``(p, K, C)`` int32 tables selected by
 device id inside ``shard_map`` -- see ``engine._direct_exec``.
 
-Restrictions (compile_plan returns None): MD/CIRC endpoints, src == dst,
-and nonzero alignments (gated by the engine caller).
+Phase 2 (ISSUE 13) closed the PR-12 restrictions: nonzero alignments
+shift the congruence residues (the local index ``i // S`` is
+alignment-independent, so only the CRT anchors move), ``[MD,⋆]``
+endpoints ride the same per-axis machinery (MD pins BOTH mesh coords --
+entry k on device ``(k%r, k%c)`` -- with stride ``lcm(r, c)``; devices
+outside the diagonal comm own the empty residue set), and ``[CIRC,CIRC]``
+endpoints compile to a costed ``'bridge'`` plan the engine executes on
+its eager root path.  ``compile_plan`` returns None only for
+``src == dst`` at identical alignments (a true no-op).  Slots are RAGGED:
+trailing all-sentinel positions are trimmed per dim, and an a2a whose
+traffic graph decomposes into smaller components runs over
+``axis_index_groups`` subgroups -- both cut the padded wire bytes the
+PR-12 plans shipped for incompatible-residue pairs.
 """
 from __future__ import annotations
 
@@ -46,14 +57,15 @@ import math
 import numpy as np
 
 from ..core import indexing as ix
-from ..core.dist import MC, MR, VC, VR, STAR, MD, CIRC, stride as dist_stride
+from ..core.dist import (MC, MR, VC, VR, STAR, MD, CIRC, md_params,
+                         stride as dist_stride)
 
 #: mesh axis names in mesh order; linear device id = mc * c + mr
 MESH_AXES = ("mc", "mr")
 
 #: mesh axes whose device coordinate each dist pins
 _PINS = {MC: ("mc",), MR: ("mr",), VC: ("mc", "mr"), VR: ("mc", "mr"),
-         STAR: ()}
+         MD: ("mc", "mr"), STAR: ()}
 
 
 def _pin(d, g: int, r: int, c: int) -> dict:
@@ -68,11 +80,18 @@ def _pin(d, g: int, r: int, c: int) -> dict:
     if d is VR:
         q = g % (r * c)
         return {"mc": q // c, "mr": q % c}
+    if d is MD:
+        return {"mc": g % r, "mr": g % c}
     return {}
 
 
-def _rank_under(d, mc: int, mr: int, r: int, c: int) -> int:
-    """The residue a device (mc, mr) owns under dist ``d`` (0 for STAR)."""
+def _rank_under(d, mc: int, mr: int, r: int, c: int):
+    """The residue a device (mc, mr) owns under dist ``d`` (0 for STAR).
+
+    For MD the residue is k0, the first diagonal entry the device owns
+    (mod lcm(r, c)); devices outside the diagonal comm ((mc - mr) not a
+    multiple of gcd(r, c)) own the EMPTY residue set -- returned as None,
+    which the map-filling loop reads as "skip this (device, slot)"."""
     if d is MC:
         return mc
     if d is MR:
@@ -81,6 +100,11 @@ def _rank_under(d, mc: int, mr: int, r: int, c: int) -> int:
         return mc + r * mr
     if d is VR:
         return mr + c * mc
+    if d is MD:
+        g, L, inv = md_params(r, c)
+        if (mc - mr) % g:
+            return None
+        return (mc + r * ((((mr - mc) // g) * inv) % (c // g))) % L
     return 0
 
 
@@ -96,13 +120,16 @@ def _lcm(a: int, b: int) -> int:
     return a // math.gcd(a, b) * b
 
 
-def comm_axes_for(src, dst, r: int, c: int) -> tuple:
+def comm_axes_for(src, dst, r: int, c: int,
+                  src_align: tuple = (0, 0), dst_align: tuple = (0, 0)) -> tuple:
     """Mesh axes that carry traffic for ``src -> dst`` on an r x c grid.
 
     An axis moves data iff the source pins it and the destination does
     not pin it with the identical residue function (same dim, same value
-    for every global index over one lcm period).  Size-1 axes never
-    carry traffic.
+    for every global index over one lcm period).  A dim alignment ``a``
+    shifts its residue function by ``a`` (the device owning global ``g``
+    is the zero-aligned owner of ``g + a``), so pins are compared at
+    ``g + align``.  Size-1 axes never carry traffic.
     """
     sizes = {"mc": r, "mr": c}
     axes = []
@@ -117,7 +144,9 @@ def comm_axes_for(src, dst, r: int, c: int) -> tuple:
             axes.append(axis)
             continue
         period = _lcm(dist_stride(sp[1], r, c), dist_stride(dp[1], r, c))
-        if any(_pin(sp[1], g, r, c)[axis] != _pin(dp[1], g, r, c)[axis]
+        s_al, d_al = src_align[sp[0]], dst_align[dp[0]]
+        if any(_pin(sp[1], g + s_al, r, c)[axis]
+               != _pin(dp[1], g + d_al, r, c)[axis]
                for g in range(period)):
             axes.append(axis)
     return tuple(axes)
@@ -151,7 +180,7 @@ class RedistPlan:
     dst: tuple                #: (cdist, rdist) destination pair
     gshape: tuple             #: global (m, n)
     grid_shape: tuple         #: (r, c)
-    kind: str                 #: 'local' | 'ppermute' | 'a2a'
+    kind: str                 #: 'local' | 'ppermute' | 'a2a' | 'bridge'
     comm_axes: tuple          #: mesh axes the collective runs over
     perm: tuple               #: ((src_id, dst_id), ...) for 'ppermute'
     slot_shape: tuple         #: (R, C) of one exchange slot
@@ -161,6 +190,9 @@ class RedistPlan:
     recv_cols: np.ndarray     #: (p, K, C) dst-local col of slot element
     src_local: tuple          #: (lr, lc) of the source block inside shard_map
     dst_local: tuple          #: (lr, lc) of the destination block
+    groups: tuple = ()        #: equal-size a2a subgroups of participant
+                              #: indices (``lax.all_to_all`` axis_index_groups
+                              #: order), or () for the full comm product
 
     @property
     def nslots(self) -> int:
@@ -174,10 +206,13 @@ class RedistPlan:
     def wire_bytes(self, itemsize: int) -> int:
         """Ring-model bytes RECEIVED per device for one execution.
 
-        Honest about slot padding: incompatible (sender, receiver)
-        residue pairs still ship their (zero) slots, so an inflated
-        exchange prices higher than the fused chain hop -- the
-        chain-vs-direct arbitration keys off exactly this number.
+        Honest about residual slot padding: incompatible (sender,
+        receiver) residue pairs inside one subgroup still ship their
+        (zero) slots, so an inflated exchange prices higher than the
+        fused chain hop -- the chain-vs-direct arbitration keys off
+        exactly this number.  Ragged-slot trimming and subgroup packing
+        shrink ``slot_shape``/``nslots`` first, so this prices the wire
+        actually used, not the PR-12 padded rectangle.
         """
         R, C = self.slot_shape
         slot = R * C * itemsize
@@ -185,6 +220,8 @@ class RedistPlan:
             return slot * (self.nslots - 1)       # K slots, keep 1/K
         if self.kind == "ppermute":
             return slot
+        if self.kind == "bridge":
+            return R * C * itemsize               # full matrix through root
         return 0
 
     def describe(self) -> str:
@@ -192,30 +229,44 @@ class RedistPlan:
         d = f"[{self.dst[0].value},{self.dst[1].value}]"
         R, C = self.slot_shape
         axes = ",".join(self.comm_axes) or "-"
+        grp = f", {len(self.groups)} group(s)" if self.groups else ""
         return (f"{s}->{d}: {self.kind} over ({axes}), {self.rounds} "
-                f"round(s), {self.nslots} slot(s) of {R}x{C}")
+                f"round(s), {self.nslots} slot(s) of {R}x{C}{grp}")
 
 
 @functools.lru_cache(maxsize=None)
 def compile_plan(src: tuple, dst: tuple, gshape: tuple,
-                 grid_shape: tuple):
+                 grid_shape: tuple,
+                 src_align: tuple = (0, 0), dst_align: tuple = (0, 0)):
     """Compile ``src -> dst`` on ``grid_shape`` into a one-shot plan.
 
-    Returns None when no one-shot plan exists: MD/CIRC endpoints (slot
-    permutations / eager root bridges) and ``src == dst`` (a no-op or a
-    pure re-alignment, both already optimal in the engine).
+    Covers the full ``LEGAL_PAIRS x LEGAL_PAIRS`` matrix at arbitrary
+    legal alignments.  Returns None only for ``src == dst`` at identical
+    alignments (a true no-op -- whitelisted by the coverage gate) and
+    for MD endpoints at nonzero alignments (which the engine rejects
+    before planning).  ``[CIRC,CIRC]`` endpoints compile to a ``'bridge'``
+    plan: costed metadata (1 round, full-matrix bytes) executed by the
+    engine's eager root path.
     """
     src, dst = tuple(src), tuple(dst)
+    src_align, dst_align = tuple(src_align), tuple(dst_align)
     r, c = grid_shape
     p = r * c
-    if src == dst:
+    if src == dst and src_align == dst_align:
         return None
-    for d in (*src, *dst):
-        if d in (MD, CIRC):
-            return None
     m, n = gshape
+    if CIRC in (*src, *dst):
+        empty = np.zeros((p, 1, 0), np.int32)
+        empty.setflags(write=False)
+        return RedistPlan(
+            src=src, dst=dst, gshape=(m, n), grid_shape=(r, c),
+            kind="bridge", comm_axes=(), perm=(), slot_shape=(m, n),
+            send_rows=empty, send_cols=empty, recv_rows=empty,
+            recv_cols=empty, src_local=(0, 0), dst_local=(0, 0))
+    if MD in (*src, *dst) and (src_align != (0, 0) or dst_align != (0, 0)):
+        return None                       # engine raises before planning
     sizes = {"mc": r, "mr": c}
-    comm = comm_axes_for(src, dst, r, c)
+    comm = comm_axes_for(src, dst, r, c, src_align, dst_align)
     K = 1
     for a in comm:
         K *= sizes[a]
@@ -265,20 +316,50 @@ def compile_plan(src: tuple, dst: tuple, gshape: tuple,
             for dim, (ext, L, Ssrc, Sdst, s_len, d_len, smap, rmap, cnt) \
                     in enumerate(dims):
                 ds_, dd_ = src[dim], dst[dim]
-                # d as SENDER to receiver `other`
-                hit = _crt(_rank_under(ds_, *own, r, c) % Ssrc, Ssrc,
-                           _rank_under(dd_, *other, r, c) % Sdst, Sdst)
-                if hit is not None:
-                    gi = hit[0] + np.arange(cnt, dtype=np.int64) * L
-                    smap[d, k, :] = np.where(gi < ext, gi // Ssrc, s_len)
+                s_al, d_al = src_align[dim], dst_align[dim]
+                rs_own = _rank_under(ds_, *own, r, c)
+                rs_oth = _rank_under(ds_, *other, r, c)
+                rd_own = _rank_under(dd_, *own, r, c)
+                rd_oth = _rank_under(dd_, *other, r, c)
+                # d as SENDER to receiver `other`.  A dim alignment `a`
+                # shifts the owned residue set: device with residue rho
+                # owns i = (rho - a) (mod S).  None = owns nothing (MD
+                # off-diagonal): skip, the slot stays sentinel padding.
+                if rs_own is not None and rd_oth is not None:
+                    hit = _crt((rs_own - s_al) % Ssrc, Ssrc,
+                               (rd_oth - d_al) % Sdst, Sdst)
+                    if hit is not None:
+                        gi = hit[0] + np.arange(cnt, dtype=np.int64) * L
+                        smap[d, k, :] = np.where(gi < ext, gi // Ssrc, s_len)
                 # d as RECEIVER of slot k (sent by `other`)
-                hit = _crt(_rank_under(ds_, *other, r, c) % Ssrc, Ssrc,
-                           _rank_under(dd_, *own, r, c) % Sdst, Sdst)
-                if hit is not None:
-                    gi = hit[0] + np.arange(cnt, dtype=np.int64) * L
-                    rmap[d, k, :] = np.where(gi < ext, gi // Sdst, d_len)
+                if rs_oth is not None and rd_own is not None:
+                    hit = _crt((rs_oth - s_al) % Ssrc, Ssrc,
+                               (rd_own - d_al) % Sdst, Sdst)
+                    if hit is not None:
+                        gi = hit[0] + np.arange(cnt, dtype=np.int64) * L
+                        rmap[d, k, :] = np.where(gi < ext, gi // Sdst, d_len)
 
-    kind, perm = ("local", ()) if not comm else ("a2a", ())
+    # Ragged slots, part 1: per-row valid entries are a front prefix
+    # (gi = hit0 + t*L is increasing), so the union of used positions is
+    # a prefix too -- trim the trailing all-sentinel tail of each dim.
+    # Sender slot position t and receiver slot position t address the
+    # same global element by construction (same CRT enumeration), so a
+    # joint trim preserves the correspondence.
+    def _prefix(mask_s: np.ndarray, mask_r: np.ndarray) -> int:
+        used = mask_s.any(axis=(0, 1)) | mask_r.any(axis=(0, 1))
+        nz = np.nonzero(used)[0]
+        return int(nz[-1]) + 1 if len(nz) else 1
+
+    R_used = _prefix(send_rows < src_lr, recv_rows < dst_lr)
+    C_used = _prefix(send_cols < src_lc, recv_cols < dst_lc)
+    if (R_used, C_used) != (R, C):
+        R, C = R_used, C_used
+        send_rows = np.ascontiguousarray(send_rows[:, :, :R])
+        recv_rows = np.ascontiguousarray(recv_rows[:, :, :R])
+        send_cols = np.ascontiguousarray(send_cols[:, :, :C])
+        recv_cols = np.ascontiguousarray(recv_cols[:, :, :C])
+
+    kind, perm, a2a_groups = ("local", (), ()) if not comm else ("a2a", (), ())
     if comm:
         ne_send = ((send_rows < src_lr).any(-1) & (send_cols < src_lc).any(-1))
         ne_recv = ((recv_rows < dst_lr).any(-1) & (recv_cols < dst_lc).any(-1))
@@ -313,6 +394,62 @@ def compile_plan(src: tuple, dst: tuple, gshape: tuple,
                                      recv_rows[ar, sel_r], dst_lr)[:, None, :]
                 recv_cols = np.where(ne_recv[ar, sel_r][:, None],
                                      recv_cols[ar, sel_r], dst_lc)[:, None, :]
+        if kind == "a2a" and K > 1:
+            # Ragged slots, part 2: incompatible residue pairs (e.g. the
+            # MD diagonal talking only to itself) leave whole slots empty.
+            # Build the UNION traffic graph over participant indices
+            # (shared across outer mesh groups -- axis_index_groups applies
+            # one partition to every outer coordinate), take its connected
+            # components, and when they pack exactly into equal bins of
+            # K* = max component size, run the a2a over those subgroups
+            # with K* slots instead of K.
+            ne = ne_send | ne_recv
+            adj = [set() for _ in range(K)]
+            for d in range(p):
+                q = pidx(d)
+                for k in np.nonzero(ne[d])[0]:
+                    adj[q].add(int(k))
+                    adj[int(k)].add(q)
+            seen = [False] * K
+            comps = []
+            for s0 in range(K):
+                if seen[s0]:
+                    continue
+                stack, comp = [s0], []
+                seen[s0] = True
+                while stack:
+                    v = stack.pop()
+                    comp.append(v)
+                    for w in adj[v]:
+                        if not seen[w]:
+                            seen[w] = True
+                            stack.append(w)
+                comps.append(sorted(comp))
+            kstar = max(len(cm) for cm in comps)
+            if kstar < K:
+                bins, ok = [], True
+                for comp in sorted(comps, key=len, reverse=True):
+                    for b in bins:
+                        if len(b) + len(comp) <= kstar:
+                            b.extend(comp)
+                            break
+                    else:
+                        bins.append(list(comp))
+                ok = all(len(b) == kstar for b in bins) \
+                    and len(bins) * kstar == K
+                if ok:
+                    a2a_groups = tuple(tuple(sorted(b)) for b in bins)
+                    group_of = {}
+                    for b in a2a_groups:
+                        for q in b:
+                            group_of[q] = b
+                    sel = np.array([group_of[pidx(d)] for d in range(p)],
+                                   dtype=np.int64)       # (p, K*)
+                    ar = np.arange(p)[:, None]
+                    send_rows = np.ascontiguousarray(send_rows[ar, sel])
+                    send_cols = np.ascontiguousarray(send_cols[ar, sel])
+                    recv_rows = np.ascontiguousarray(recv_rows[ar, sel])
+                    recv_cols = np.ascontiguousarray(recv_cols[ar, sel])
 
     for t in (send_rows, send_cols, recv_rows, recv_cols):
         t.setflags(write=False)
@@ -321,4 +458,5 @@ def compile_plan(src: tuple, dst: tuple, gshape: tuple,
         comm_axes=comm, perm=perm, slot_shape=(R, C),
         send_rows=send_rows, send_cols=send_cols,
         recv_rows=recv_rows, recv_cols=recv_cols,
-        src_local=(src_lr, src_lc), dst_local=(dst_lr, dst_lc))
+        src_local=(src_lr, src_lc), dst_local=(dst_lr, dst_lc),
+        groups=a2a_groups)

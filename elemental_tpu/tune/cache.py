@@ -51,6 +51,12 @@ from ..obs import metrics as _metrics
 
 SCHEMA = "tuning_cache/v1"
 
+#: schema tag of measured redistribution machine constants (ISSUE 13):
+#: per-(grid, backend) alpha (seconds/round) and bandwidth (bytes/s)
+#: fitted by ``python -m perf.redist_bench --record`` and consulted by the
+#: engine's ``path='auto'`` arbitration before the static ring model
+REDIST_SCHEMA = "redist_constants/v1"
+
 #: environment override for the cache directory
 ENV_DIR = "ELEMENTAL_TPU_TUNE_CACHE"
 
@@ -193,6 +199,108 @@ def load(key: CacheKey) -> dict | None:
     return doc
 
 
+# ---------------------------------------------------------------------
+# measured redistribution constants (redist_constants/v1, ISSUE 13)
+# ---------------------------------------------------------------------
+
+#: per-process memo of loaded constants docs, keyed (dir, filename);
+#: invalidated by save_redist_constants so a freshly recorded fit takes
+#: effect immediately (the engine consults these on EVERY 'auto' call)
+_REDIST_MEMO: dict = {}
+
+
+def redist_constants_filename(grid_shape, backend: str) -> str:
+    r, c = grid_shape
+    return f"redist_constants__g{r}x{c}__{backend}.json"
+
+
+def save_redist_constants(grid_shape, backend: str, alpha_s: float,
+                          bw_bytes_per_s: float, nsamples: int = 0,
+                          metric: dict | None = None) -> str:
+    """Atomically persist measured alpha/beta machine constants for one
+    (grid, backend); returns the path.  Same unwritable-directory
+    degradation as :func:`save` (warn once, in-process fallback)."""
+    grid_shape = tuple(int(v) for v in grid_shape)
+    doc = {"schema": REDIST_SCHEMA, "grid": list(grid_shape),
+           "backend": str(backend), "alpha_s": float(alpha_s),
+           "bw_bytes_per_s": float(bw_bytes_per_s),
+           "nsamples": int(nsamples), "created": time.time()}
+    if metric:
+        doc["metric"] = dict(metric)
+    d = cache_dir()
+    name = redist_constants_filename(grid_shape, backend)
+    path = os.path.join(d, name)
+    _REDIST_MEMO.pop((d, name), None)
+    try:
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".redist_", suffix=".tmp")
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, path)            # atomic on POSIX
+    except OSError as exc:
+        _warn_unwritable(d, exc)
+        _MEM_FALLBACK[name] = doc
+        _metrics.inc("tune_cache_events", op="redist_constants",
+                     event="write_fallback")
+        return path
+    _metrics.inc("tune_cache_events", op="redist_constants", event="write")
+    return path
+
+
+def load_redist_constants(grid_shape, backend: str) -> dict | None:
+    """The measured constants doc for (grid, backend), or None.
+
+    Defensive like :func:`load`: unreadable/unparsable files, a schema
+    other than ``redist_constants/v1``, mismatched grid/backend fields,
+    or non-finite/non-positive constants all return None (the engine then
+    falls back to the static ring model).  Results are memoized per
+    (directory, file) -- 'auto' arbitration consults this on every call."""
+    grid_shape = tuple(int(v) for v in grid_shape)
+    d = cache_dir()
+    name = redist_constants_filename(grid_shape, backend)
+    memo_key = (d, name)
+    if memo_key in _REDIST_MEMO:
+        return _REDIST_MEMO[memo_key]
+    doc = None
+    try:
+        with open(os.path.join(d, name)) as f:
+            doc = json.load(f)
+    except OSError:
+        doc = _MEM_FALLBACK.get(name)
+    except ValueError:
+        _metrics.inc("tune_cache_events", op="redist_constants",
+                     event="unparsable")
+        doc = None
+    if doc is not None:
+        if (not isinstance(doc, dict)
+                or doc.get("schema") != REDIST_SCHEMA
+                or tuple(doc.get("grid", ())) != grid_shape
+                or doc.get("backend") != backend):
+            _metrics.inc("tune_cache_events", op="redist_constants",
+                         event="stale_schema")
+            doc = None
+        else:
+            try:
+                a, bw = float(doc["alpha_s"]), float(doc["bw_bytes_per_s"])
+                ok = a >= 0 and bw > 0 and a == a and bw == bw \
+                    and a != float("inf") and bw != float("inf")
+            except (KeyError, TypeError, ValueError):
+                ok = False
+            if not ok:
+                _metrics.inc("tune_cache_events", op="redist_constants",
+                             event="key_mismatch")
+                doc = None
+    _REDIST_MEMO[memo_key] = doc
+    return doc
+
+
+def clear_redist_constants_memo() -> None:
+    """Drop the in-process constants memo (tests that swap cache dirs or
+    rewrite files out-of-band call this between phases)."""
+    _REDIST_MEMO.clear()
+
+
 def scan() -> tuple:
     """(valid docs, rejects) across the whole cache directory.
 
@@ -211,6 +319,8 @@ def scan() -> tuple:
     for name in names:
         if not name.endswith(".json"):
             continue
+        if name.startswith("redist_constants__"):
+            continue                     # machine constants, not winners
         op = name.split("__", 1)[0]
         try:
             with open(os.path.join(d, name)) as f:

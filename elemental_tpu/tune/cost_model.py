@@ -282,21 +282,23 @@ def _trace_stats(op: str, dims_t, nb_t: int, la, xo_t, grid, dtype,
 
         def fn(a):
             from ..lapack.qr import qr
-            return qr(dm(a, m, n), nb=nb_t, panel=panel)
+            return qr(dm(a, m, n), nb=nb_t, panel=panel,
+                      redist_path=redist_path)
         args = (inp(m, n),)
     elif op == "trsm":
         m, n = dims_t[0], dims_t[-1]
 
         def fn(a, b):
             from ..blas.level3 import trsm
-            return trsm("L", "L", "N", dm(a, m, m), dm(b, m, n), nb=nb_t)
+            return trsm("L", "L", "N", dm(a, m, m), dm(b, m, n), nb=nb_t,
+                        redist_path=redist_path)
         args = (inp(m, m), inp(m, n))
     elif op == "herk":
         m, k = dims_t[0], dims_t[-1]
 
         def fn(a):
             from ..blas.level3 import herk
-            return herk("L", dm(a, m, k), nb=nb_t)
+            return herk("L", dm(a, m, k), nb=nb_t, redist_path=redist_path)
         args = (inp(m, k),)
     else:
         raise KeyError(f"no trace builder for op {op!r}")
@@ -334,11 +336,11 @@ def _traced_cost(op: str, config: dict, ctx: TuneContext, grid, dtype,
     nb = config.get("nb")
     panel = config.get("panel") or "classic"
     cpm = config.get("comm_precision")
-    # redist_path (ISSUE 12) reaches the traced driver, so the direct
+    # redist_path (ISSUE 12/13) reaches the traced driver, so the direct
     # route's collective counts/bytes are read off its REAL schedule --
     # the "one a2a round vs k gather rounds" term is the trace itself.
-    # Only the ops that accept the knob get it (qr/trsm/herk chain-only).
-    rp = config.get("redist_path") if op in ("lu", "cholesky") else None
+    rp = config.get("redist_path") \
+        if op in ("lu", "cholesky", "qr", "trsm", "herk") else None
     dims_t, nb_t, xo_t, lat_scale, byte_scale = _geometry(ctx, nb, xo, la)
     stats = _trace_stats(op, dims_t, nb_t, la, xo_t, grid, dtype, panel, rp)
     rounds = stats["rounds"] * lat_scale

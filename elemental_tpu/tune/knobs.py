@@ -188,13 +188,16 @@ def _lu_space(ctx: TuneContext, pinned: dict) -> list:
 
 def _qr_space(ctx: TuneContext, pinned: dict) -> list:
     base = {k: v for k, v in pinned.items() if k != "panel"}
-    return _with_comm_precision(
-        _with_panels(_nb_only_space(ctx, base), ctx, pinned, QR_PANELS),
-        ctx, pinned)
+    return _with_redist_path(
+        _with_comm_precision(
+            _with_panels(_nb_only_space(ctx, base), ctx, pinned, QR_PANELS),
+            ctx, pinned), ctx, pinned)
 
 
 def _nb_comm_space(ctx: TuneContext, pinned: dict) -> list:
-    return _with_comm_precision(_nb_only_space(ctx, pinned), ctx, pinned)
+    return _with_redist_path(
+        _with_comm_precision(_nb_only_space(ctx, pinned), ctx, pinned),
+        ctx, pinned)
 
 
 #: gemm candidate order doubles as the deterministic tie-break: on a 1x1
@@ -235,11 +238,14 @@ OPS = {
                         _cholesky_space),
     "lu": OpSpace("lu", ("nb", "lookahead", "crossover", "panel",
                          "comm_precision", "redist_path"), _lu_space),
-    "qr": OpSpace("qr", ("nb", "panel", "comm_precision"), _qr_space),
+    "qr": OpSpace("qr", ("nb", "panel", "comm_precision", "redist_path"),
+                  _qr_space),
     "gemm": OpSpace("gemm", ("alg", "nb", "comm_precision", "redist_path"),
                     _gemm_space),
-    "trsm": OpSpace("trsm", ("nb", "comm_precision"), _nb_comm_space),
-    "herk": OpSpace("herk", ("nb", "comm_precision"), _nb_comm_space),
+    "trsm": OpSpace("trsm", ("nb", "comm_precision", "redist_path"),
+                    _nb_comm_space),
+    "herk": OpSpace("herk", ("nb", "comm_precision", "redist_path"),
+                    _nb_comm_space),
 }
 
 

@@ -125,7 +125,8 @@ def _builders(op: str, dims, grid, dtype):
         def factory(cfg):
             return jax.jit(lambda a: tuple(el.qr(
                 a, nb=cfg.get("nb"), panel=cfg.get("panel") or "classic",
-                comm_precision=cfg.get("comm_precision"), precision=HI)),
+                comm_precision=cfg.get("comm_precision"),
+                redist_path=cfg.get("redist_path"), precision=HI)),
                 donate_argnums=0)
         return make, factory
     if op == "trsm":
@@ -146,6 +147,7 @@ def _builders(op: str, dims, grid, dtype):
             return jax.jit(lambda ab: el.trsm(
                 "L", "L", "N", ab[0], ab[1], nb=cfg.get("nb"),
                 comm_precision=cfg.get("comm_precision"),
+                redist_path=cfg.get("redist_path"),
                 precision=HI).local,
                 donate_argnums=0)
         return make, factory
@@ -161,6 +163,7 @@ def _builders(op: str, dims, grid, dtype):
             return jax.jit(lambda a: el.herk(
                 "L", a, nb=cfg.get("nb"),
                 comm_precision=cfg.get("comm_precision"),
+                redist_path=cfg.get("redist_path"),
                 precision=HI).local,
                 donate_argnums=0)
         return make, factory

@@ -27,6 +27,13 @@ Usage:
                                                   #   pairs, tiny roofline
     python -m perf.redist_bench --n 4096 --grid 2x4 --paths chain,direct
     python -m perf.redist_bench --pairs "MC,MR->MR,STAR;VC,STAR->VR,STAR"
+    python -m perf.redist_bench --record   # also least-squares-fit alpha
+                                           #   (s/round) + bandwidth from the
+                                           #   measured rows and save them as
+                                           #   redist_constants/v1 in the
+                                           #   tuning cache; the engine's
+                                           #   'auto' arbitration consults
+                                           #   them before the ring model
 
 On a CPU-only host run under
 ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` (set automatically
@@ -197,6 +204,46 @@ def p2p_gbps(grid, n=None, reps=3):
     return doc
 
 
+def fit_constants(rows):
+    """Least-squares fit ``seconds = alpha * rounds + model_bytes / bw``
+    over measured rows; returns ``(alpha_s, bw_bytes_per_s, nsamples)`` or
+    None when the system is degenerate (e.g. a 1x1 grid where every row
+    has zero rounds and zero bytes -- nothing to fit)."""
+    import numpy as np
+    samples = [(row["rounds"], row["model_bytes"], row["seconds"])
+               for row in rows if row["rounds"] > 0 and row["seconds"] > 0]
+    if len(samples) < 2:
+        return None
+    M = np.array([[float(r_), float(b_)] for r_, b_, _ in samples])
+    t = np.array([s_ for _, _, s_ in samples])
+    if np.linalg.matrix_rank(M) < 2:
+        return None
+    coef, *_ = np.linalg.lstsq(M, t, rcond=None)
+    alpha = float(max(coef[0], 1e-9))        # s per collective round
+    beta = float(max(coef[1], 1e-15))        # s per wire byte
+    return alpha, 1.0 / beta, len(samples)
+
+
+def record_constants(grid_shape, rows):
+    """Fit + persist ``redist_constants/v1`` for one grid; returns the doc
+    (with ``_path``) or None when the fit is degenerate."""
+    import jax
+    from elemental_tpu.tune.cache import (load_redist_constants,
+                                          save_redist_constants)
+    fit = fit_constants(rows)
+    if fit is None:
+        return None
+    alpha, bw, nsamples = fit
+    backend = jax.default_backend()
+    path = save_redist_constants(grid_shape, backend, alpha, bw,
+                                 nsamples=nsamples)
+    doc = dict(load_redist_constants(grid_shape, backend) or
+               {"schema": "redist_constants/v1", "alpha_s": alpha,
+                "bw_bytes_per_s": bw})
+    doc["_path"] = path
+    return doc
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] in ("-h", "--help"):
@@ -207,6 +254,7 @@ def main(argv=None) -> int:
     import elemental_tpu as el
 
     smoke = "--smoke" in argv
+    record = "--record" in argv
     n = 64 if smoke else None
     grids = None
     paths = ("chain", "direct")
@@ -214,7 +262,7 @@ def main(argv=None) -> int:
     reps = 3
     it = iter(argv)
     for arg in it:
-        if arg == "--smoke":
+        if arg in ("--smoke", "--record"):
             continue
         elif arg == "--n":
             n = int(next(it))
@@ -267,6 +315,14 @@ def main(argv=None) -> int:
                 print(f"# MISMATCH {row['pair']} on {row['grid']}",
                       file=sys.stderr)
                 return 1
+        if record:
+            doc = record_constants((gr, gc), rows)
+            if doc is None:
+                print(f"# record: degenerate fit on {gr}x{gc} "
+                      f"(no multi-device rows), nothing written",
+                      file=sys.stderr)
+            else:
+                print(json.dumps(doc))
     return 0
 
 

@@ -56,6 +56,21 @@ def test_direct_uses_one_shot_collectives_on_2x2():
         assert "all_gather" not in t, (direct, t)
 
 
+def test_redist_md_direct_ragged_byte_drop():
+    """ISSUE 13: the redist_md driver round-trips a RAGGED [MD,STAR]
+    matrix (extents n-1 x n-3, incompatible with every grid residue).
+    Its direct twin is pinned on BYTES, not rounds: the ragged-slot
+    a2a packs trimmed slots over subgroups, so the traced wire bytes
+    drop strictly below the chain's padded hops.  (The pair is
+    deliberately NOT in DIRECT_PAIRS -- its win is the byte axis.)"""
+    g = Grid(jax.devices()[:4], height=2)
+    bytes_ = {}
+    for name in ("redist_md", "redist_md_direct"):
+        plan, _, _ = an.trace_driver(name, g)
+        bytes_[name] = sum(v["bytes"] for v in plan.totals().values())
+    assert 0 < bytes_["redist_md_direct"] < bytes_["redist_md"]
+
+
 def test_every_direct_driver_has_goldens():
     """tools/check.sh's golden-coverage sweep runs driver_names() x GRIDS;
     a *_direct variant without committed goldens breaks the gate -- catch

@@ -49,10 +49,32 @@ def g22():
 # plan compiler units (pure index math, no device execution)
 # ---------------------------------------------------------------------
 
-def test_plan_none_for_noop_and_root_only_dists():
+def test_plan_none_only_for_true_noop():
+    """Phase 2 (ISSUE 13): the only whitelisted fallback is src == dst at
+    identical alignments; the former [MD,*]/[CIRC,CIRC] bailouts compile."""
     assert compile_plan((MC, MR), (MC, MR), (16, 16), (2, 2)) is None
-    assert compile_plan((MC, MR), (MD, STAR), (16, 16), (2, 2)) is None
-    assert compile_plan((CIRC, CIRC), (MC, MR), (16, 16), (2, 2)) is None
+    assert compile_plan((MC, MR), (MD, STAR), (16, 16), (2, 2)) is not None
+    assert compile_plan((CIRC, CIRC), (MC, MR), (16, 16), (2, 2)).kind \
+        == "bridge"
+    # same pair at DIFFERENT alignments is a real rotation, not a no-op
+    assert compile_plan((MC, MR), (MC, MR), (16, 16), (2, 2),
+                        (0, 0), (1, 0)).kind == "ppermute"
+
+
+@pytest.mark.parametrize("grid_shape", [(1, 1), (2, 2), (2, 4)],
+                         ids=["1x1", "2x2", "2x4"])
+def test_full_legal_pairs_coverage(grid_shape):
+    """THE coverage acceptance pin: every LEGAL_PAIRS x LEGAL_PAIRS move
+    compiles a plan; only the src == dst diagonal stays None (whitelisted
+    no-ops).  tools/check.sh runs the same sweep as a loud gate."""
+    for src in LEGAL_PAIRS:
+        for dst in LEGAL_PAIRS:
+            p = compile_plan(src, dst, (13, 9), grid_shape)
+            if src == dst:
+                assert p is None, (src, dst)
+            else:
+                assert p is not None, (src, dst)
+                assert p.kind in ("local", "ppermute", "a2a", "bridge")
 
 
 def test_plan_kinds_2x2():
@@ -162,6 +184,75 @@ def test_direct_matches_chain_2x4_full(grid24, src, dst):
 
 
 # ---------------------------------------------------------------------
+# nonzero-alignment matrix (phase 2: ISSUE 13)
+# ---------------------------------------------------------------------
+
+def _aligned_case(src, dst, r, c):
+    """((src calign, ralign), (dst calign, ralign)) stressing every
+    legal alignment: the LARGEST per source dim against a shifted
+    destination.  MD moves keep zero alignments on both endpoints (the
+    engine's ``to_dist`` contract; ``compile_plan`` mirrors it)."""
+    from elemental_tpu.core.dist import stride as dist_stride
+    if MD in src or MD in dst:
+        return (0, 0), (0, 0)
+
+    def one(pair, which):
+        out = []
+        for d in pair:
+            S = 1 if d is CIRC else dist_stride(d, r, c)
+            out.append(max(S - 1, 0) if which == "max" else min(1, S - 1))
+        return tuple(out)
+    return one(src, "max"), one(dst, "one")
+
+
+def _check_aligned_pair(grid, src, dst, F):
+    r, c = grid.height, grid.width
+    sal, dal = _aligned_case(src, dst, r, c)
+    A = from_global(F, *src, grid=grid, calign=sal[0], ralign=sal[1])
+    Bc = redistribute(A, *dst, dal[0], dal[1], path="chain")
+    Bd = redistribute(A, *dst, dal[0], dal[1], path="direct")
+    assert Bd.dist == dst and (Bd.calign, Bd.ralign) == dal
+    np.testing.assert_array_equal(np.asarray(Bd.local), np.asarray(Bc.local))
+    np.testing.assert_array_equal(np.asarray(to_global(Bd)), F)
+
+
+@pytest.mark.parametrize("dst", LEGAL_PAIRS, ids=PAIR_IDS)
+@pytest.mark.parametrize("src", LEGAL_PAIRS, ids=PAIR_IDS)
+def test_aligned_direct_matches_chain_2x2(g22, src, dst):
+    _check_aligned_pair(g22, src, dst, f(13, 9))
+
+
+@pytest.mark.parametrize("dst", LEGAL_PAIRS, ids=PAIR_IDS)
+@pytest.mark.parametrize("src", LEGAL_PAIRS, ids=PAIR_IDS)
+def test_aligned_direct_matches_chain_1x1(g11, src, dst):
+    _check_aligned_pair(g11, src, dst, f(13, 9))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("dst", LEGAL_PAIRS, ids=PAIR_IDS)
+@pytest.mark.parametrize("src", LEGAL_PAIRS, ids=PAIR_IDS)
+def test_aligned_direct_matches_chain_2x4_full(grid24, src, dst):
+    _check_aligned_pair(grid24, src, dst, f(19, 11))
+
+
+def test_ragged_slots_beat_padded_plan_bytes():
+    """ISSUE 13 byte acceptance: for an incompatible-residue pair the
+    trimmed + subgroup-packed slots ship STRICTLY fewer wire bytes than
+    the PR-12 padded plan (full-mesh exchange at max-local slot shape)."""
+    from elemental_tpu.core import indexing as ix
+    from elemental_tpu.core.dist import stride as dist_stride
+    p = compile_plan((MD, STAR), (STAR, MD), (7, 5), (2, 2))
+    assert p.kind == "a2a" and p.groups       # subgroup-packed
+    # padded PR-12 model: 4 slots of (max_local x max_local) on the ring
+    R_pad = ix.max_local_length(7, dist_stride(MD, 2, 2))
+    C_pad = ix.max_local_length(5, 1)
+    padded = R_pad * C_pad * 4 * (4 - 1)
+    assert 0 < p.wire_bytes(4) < padded
+    # the trimmed slot is strictly smaller than the padded one too
+    assert p.slot_shape[0] * p.slot_shape[1] < R_pad * C_pad
+
+
+# ---------------------------------------------------------------------
 # comm_precision codec composition
 # ---------------------------------------------------------------------
 
@@ -259,6 +350,54 @@ def test_obs_comm_events_carry_path_fields(g22):
     assert direct_ev.engine_wire_bytes > 0
     # the ring-model estimate is path-independent (same logical move)
     assert chain_ev.wire_bytes == direct_ev.wire_bytes == chain_ev.bytes
+
+
+def test_fallback_reason_and_obs_counter(g22):
+    """A 'direct'/'auto' request that ends on the chain is VISIBLE: the
+    RedistRecord carries fallback_reason and the obs registry counts a
+    redist_fallbacks increment labeled with it (ISSUE 13 satellite)."""
+    from elemental_tpu.obs import metrics
+    A = from_global(f(13, 9), MC, MR, grid=g22)
+    with metrics.scoped() as reg:
+        with engine.redist_trace() as log:
+            redistribute(A, MC, MR, path="direct")       # a no-op move
+        assert log[-1].path == "chain"
+        assert log[-1].fallback_reason == "noop"
+        assert reg.counter_value("redist_fallbacks", reason="noop") == 1
+    # the happy path records NO reason
+    with engine.redist_trace() as log:
+        redistribute(A, MR, STAR, path="direct")
+    assert log[-1].path == "direct" and log[-1].fallback_reason == ""
+
+
+def test_auto_consults_measured_constants(g22, tmp_path, monkeypatch):
+    """ISSUE 13 acceptance: 'auto' arbitration reads the recorded
+    redist_constants/v1 -- injected constants demonstrably FLIP the
+    winner for the same move, and the chain pick is labeled
+    'arbitration' in the trace record."""
+    import jax as _jax
+    from elemental_tpu.tune import cache as tcache
+    monkeypatch.setenv(tcache.ENV_DIR, str(tmp_path))
+    tcache.clear_redist_constants_memo()
+    backend = _jax.default_backend()
+    A = from_global(f(13, 9), MC, MR, grid=g22)
+    try:
+        # latency-dominated fabric: the 1-round one-shot plan must win
+        # over the 3-hop chain despite its larger byte total
+        tcache.save_redist_constants((2, 2), backend, alpha_s=1.0,
+                                     bw_bytes_per_s=1e18, nsamples=4)
+        with engine.redist_trace() as log:
+            redistribute(A, MR, STAR, path="auto")
+        assert log[-1].path == "direct"
+        # bandwidth-starved fabric: the chain's smaller byte total wins
+        tcache.save_redist_constants((2, 2), backend, alpha_s=1e-12,
+                                     bw_bytes_per_s=1.0, nsamples=4)
+        with engine.redist_trace() as log:
+            redistribute(A, MR, STAR, path="auto")
+        assert log[-1].path == "chain"
+        assert log[-1].fallback_reason == "arbitration"
+    finally:
+        tcache.clear_redist_constants_memo()
 
 
 def test_row_permute_records_reach_observers_not_goldens(g22):

@@ -1,0 +1,125 @@
+"""Measured redist constants (ISSUE 13): the ``redist_constants/v1``
+cache round-trip, its defensive load paths, the redist_bench
+least-squares fit, and the ``--record`` CLI wiring.
+
+The contract: ``perf.redist_bench --record`` fits ``seconds =
+alpha * rounds + bytes / bw`` over measured rows and persists one
+per-(grid, backend) doc that :func:`engine._machine_terms` consults
+BEFORE the static ring model -- so 'auto' arbitration runs on the
+machine actually measured, not on TPU-ish defaults.  The arbitration
+flip itself is pinned in tests/core/test_redist_direct.py.
+"""
+import json
+import os
+
+import jax
+import pytest
+
+from elemental_tpu.tune import cache as tcache
+
+GRID = (2, 2)
+
+
+@pytest.fixture()
+def cache_env(tmp_path, monkeypatch):
+    monkeypatch.setenv(tcache.ENV_DIR, str(tmp_path))
+    tcache.clear_redist_constants_memo()
+    yield str(tmp_path)
+    tcache.clear_redist_constants_memo()
+
+
+def test_save_load_round_trip(cache_env):
+    backend = jax.default_backend()
+    path = tcache.save_redist_constants(GRID, backend, alpha_s=3e-6,
+                                        bw_bytes_per_s=1.25e10, nsamples=12)
+    assert os.path.dirname(path) == cache_env
+    doc = tcache.load_redist_constants(GRID, backend)
+    assert doc["schema"] == tcache.REDIST_SCHEMA
+    assert doc["alpha_s"] == pytest.approx(3e-6)
+    assert doc["bw_bytes_per_s"] == pytest.approx(1.25e10)
+    assert doc["nsamples"] == 12
+    # a rewrite invalidates the memo (save pops the entry)
+    tcache.save_redist_constants(GRID, backend, alpha_s=5e-6,
+                                 bw_bytes_per_s=1e10)
+    assert tcache.load_redist_constants(GRID, backend)["alpha_s"] \
+        == pytest.approx(5e-6)
+
+
+def test_load_is_defensive(cache_env):
+    backend = jax.default_backend()
+    # absent file -> None (memoized None included)
+    assert tcache.load_redist_constants(GRID, backend) is None
+    # wrong backend / wrong grid -> None
+    tcache.save_redist_constants(GRID, backend, 1e-6, 1e10)
+    assert tcache.load_redist_constants((4, 2), backend) is None
+    assert tcache.load_redist_constants(GRID, backend + "_other") is None
+    # corrupt JSON -> None, never raises
+    name = tcache.redist_constants_filename(GRID, backend)
+    with open(os.path.join(cache_env, name), "w") as fh:
+        fh.write("{not json")
+    tcache.clear_redist_constants_memo()
+    assert tcache.load_redist_constants(GRID, backend) is None
+    # non-finite / non-positive constants -> None
+    doc = {"schema": tcache.REDIST_SCHEMA, "grid": list(GRID),
+           "backend": backend, "alpha_s": 1e-6, "bw_bytes_per_s": 0.0}
+    with open(os.path.join(cache_env, name), "w") as fh:
+        json.dump(doc, fh)
+    tcache.clear_redist_constants_memo()
+    assert tcache.load_redist_constants(GRID, backend) is None
+
+
+def test_scan_skips_constants_files(cache_env):
+    """scan() enumerates measured OP entries only; the constants doc has
+    its own schema and must not surface as a tuning entry."""
+    tcache.save_redist_constants(GRID, jax.default_backend(), 1e-6, 1e10)
+    docs, rejects = tcache.scan()
+    assert docs == [] and rejects == []
+
+
+def test_fit_constants_recovers_planted_terms():
+    from perf.redist_bench import fit_constants
+    alpha, bw = 5e-6, 2e10
+    rows = [{"rounds": r, "model_bytes": b,
+             "seconds": alpha * r + b / bw}
+            for r, b in ((1, 1 << 20), (3, 1 << 18), (2, 1 << 22),
+                         (4, 1 << 16), (1, 1 << 24))]
+    fit = fit_constants(rows)
+    assert fit is not None
+    a_fit, bw_fit, nsamples = fit
+    assert a_fit == pytest.approx(alpha, rel=1e-6)
+    assert bw_fit == pytest.approx(bw, rel=1e-6)
+    assert nsamples == len(rows)
+
+
+def test_fit_constants_degenerate_returns_none():
+    from perf.redist_bench import fit_constants
+    # all-zero rounds (a 1x1 grid's rows) -> nothing to fit
+    assert fit_constants([{"rounds": 0, "model_bytes": 0, "seconds": 0.0}
+                          for _ in range(4)]) is None
+    # a single usable sample is rank-deficient
+    assert fit_constants([{"rounds": 1, "model_bytes": 100,
+                           "seconds": 1e-4}]) is None
+
+
+def test_record_constants_persists_and_reloads(cache_env):
+    from perf.redist_bench import record_constants
+    alpha, bw = 2e-6, 4e10
+    rows = [{"rounds": r, "model_bytes": b,
+             "seconds": alpha * r + b / bw}
+            for r, b in ((1, 1 << 20), (3, 1 << 19), (2, 1 << 21))]
+    doc = record_constants(GRID, rows)
+    assert doc is not None and os.path.exists(doc["_path"])
+    reloaded = tcache.load_redist_constants(GRID, jax.default_backend())
+    assert reloaded["alpha_s"] == pytest.approx(alpha, rel=1e-5)
+    assert reloaded["bw_bytes_per_s"] == pytest.approx(bw, rel=1e-5)
+
+
+@pytest.mark.slow
+def test_cli_record_writes_the_cache(cache_env):
+    """End to end: ``python -m perf.redist_bench --record`` (tiny n) lands
+    a loadable redist_constants/v1 doc for the measured grid."""
+    from perf.redist_bench import main
+    rc = main(["--grid", "2x2", "--n", "32", "--reps", "1", "--record"])
+    assert rc == 0
+    doc = tcache.load_redist_constants(GRID, jax.default_backend())
+    assert doc is not None and doc["nsamples"] >= 2

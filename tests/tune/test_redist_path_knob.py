@@ -20,13 +20,11 @@ def _ctx(op, dims, grid_shape):
                        grid_shape=grid_shape, backend="cpu")
 
 
-def test_knob_registered_on_redistribution_heavy_ops_only():
-    for op in ("cholesky", "lu", "gemm"):
+def test_knob_registered_on_all_six_drivers():
+    # ISSUE 13: qr/trsm/herk joined lu/cholesky/gemm -- every driver's
+    # operand moves are plan-shaped now, so every space carries the knob
+    for op in ("cholesky", "lu", "gemm", "qr", "trsm", "herk"):
         assert "redist_path" in OPS[op].knobs, op
-    # ops whose schedules route through TSQR trees / triangular solves
-    # keep their space un-doubled until a direct schedule exists for them
-    for op in ("qr", "trsm", "herk"):
-        assert "redist_path" not in OPS[op].knobs, op
 
 
 def test_knob_values_sync_with_engine():
@@ -99,6 +97,45 @@ def test_path_none_closed_form_unchanged_by_the_knob_plumbing():
     assert bare.comm_bytes == keyed.comm_bytes
     assert bare.rounds == keyed.rounds
     assert bare.prim_counts == keyed.prim_counts
+
+
+def test_traced_qr_trsm_herk_price_the_one_shot_schedule():
+    """ISSUE 13: the three remaining drivers price 'direct' by re-tracing
+    their REAL schedules with the knob threaded through.  herk's
+    per-panel [VC,STAR]-hop + spread pair (2 rounds) collapses into ONE
+    exchange, so its round count strictly drops; qr/trsm panel moves are
+    already single-round, so their round counts hold while the prim mix
+    swaps the fused gathers for one-shot plans."""
+    g2 = _grid(2, 2)
+    cases = {"qr": {"nb": 16, "panel": "classic", "comm_precision": None},
+             "trsm": {"nb": 16, "comm_precision": None},
+             "herk": {"nb": 16, "comm_precision": None}}
+    scores = {}
+    for op, cfg in cases.items():
+        ctx = _ctx(op, (64, 64), (2, 2))
+        base = cost_model.score_config(
+            op, dict(cfg, redist_path=None), ctx=ctx, grid=g2,
+            dtype=jnp.float32)
+        direct = cost_model.score_config(
+            op, dict(cfg, redist_path="direct"), ctx=ctx, grid=g2,
+            dtype=jnp.float32)
+        assert direct.rounds <= base.rounds, op
+        assert direct.prim_counts != base.prim_counts, op
+        scores[op] = (base, direct)
+    base, direct = scores["herk"]
+    assert direct.rounds < base.rounds
+    assert base.prim_counts.get("all_gather", 0) > 0
+    assert direct.prim_counts.get("all_gather", 0) == 0
+
+
+def test_candidates_carry_the_knob_for_qr_trsm_herk():
+    for op in ("qr", "trsm", "herk"):
+        ctx1 = _ctx(op, (64, 64), (1, 1))
+        assert {c.get("redist_path")
+                for c in candidate_configs(ctx1)} == {None}, op
+        ctx2 = _ctx(op, (64, 64), (2, 2))
+        assert {c.get("redist_path")
+                for c in candidate_configs(ctx2)} == set(REDIST_PATHS), op
 
 
 def test_traced_lu_direct_prices_the_real_one_shot_schedule():

@@ -38,7 +38,12 @@ TFLOP/s headlines.  Nested documents under the
 ``"obs"`` key (the ``obs_bench/v1`` trail, including ISSUE 8's
 ``redist_wire_bytes`` total) are accepted and surfaced as informational
 lines, never gated -- byte estimates are schedule properties, not
-chip-weather measurements.  Metrics absent from the
+chip-weather measurements.  The one exception (ISSUE 13) is the
+MEASURED one-shot redistribution rate: :func:`load_doc` promotes
+``obs.redist_p2p_gbps.direct`` to a top-level ``redist_p2p_gbps`` key
+gated alongside the TFLOP/s headlines (wide 40% band -- interconnect
+microbenchmarks swing with fabric weather; zero-rate 1x1 runs are
+skipped, not compared).  Metrics absent from the
 current run or from every baseline are skipped with a note (older rounds
 predate some metrics) -- which is also how METRIC RENAMES stay
 false-positive-free: the bench names its headline values
@@ -58,7 +63,8 @@ import sys
 
 DEFAULT_METRICS = ("vs_baseline", "lu_vs_baseline",
                    "lu_n32768_tflops_per_chip",
-                   "serve_p99_ms", "serve_solves_per_sec")
+                   "serve_p99_ms", "serve_solves_per_sec",
+                   "redist_p2p_gbps")
 DEFAULT_THRESHOLD = 0.10
 
 #: built-in per-metric thresholds (user ``--threshold NAME=X`` overrides).
@@ -68,7 +74,8 @@ DEFAULT_THRESHOLD = 0.10
 #: with host weather and get the same wide band.
 DEFAULT_PER_METRIC = {"lu_n32768_tflops_per_chip": 0.25,
                       "serve_p99_ms": 0.25,
-                      "serve_solves_per_sec": 0.25}
+                      "serve_solves_per_sec": 0.25,
+                      "redist_p2p_gbps": 0.40}
 
 #: metrics where SMALLER is better (latency percentiles from
 #: bench_serve.py): the gate inverts -- best baseline is the MINIMUM and
@@ -95,6 +102,16 @@ def load_doc(path: str) -> dict:
         if isinstance(name, str) and isinstance(val, (int, float)) \
                 and name not in doc:
             doc[name] = val
+    # the measured one-shot redistribution rate joins the gated set
+    # (ISSUE 13): a zero rate means a 1x1/no-wire run -- skip it so a
+    # single-chip round cannot poison the baseline or fail the gate
+    obs = doc.get("obs")
+    if isinstance(obs, dict) and "redist_p2p_gbps" not in doc:
+        p2p = obs.get("redist_p2p_gbps")
+        if isinstance(p2p, dict) and isinstance(p2p.get("direct"),
+                                                (int, float)) \
+                and p2p["direct"] > 0:
+            doc["redist_p2p_gbps"] = p2p["direct"]
     return doc
 
 

@@ -38,11 +38,15 @@
 #                             #   panel granularity, recompute count == 1)
 #                             #   + the *_abft comm-plan golden diff +
 #                             #   tests/resilience/test_abft.py
-#   tools/check.sh redist     # one-shot redistribution gate (ISSUE 12):
-#                             #   plan-compiler unit + direct-vs-chain
-#                             #   bit-equivalence tests, the *_direct
-#                             #   comm-plan golden diff (strict round
-#                             #   wins pinned), the redist_path knob
+#   tools/check.sh redist     # one-shot redistribution gate (ISSUE 12 +
+#                             #   13): plan-compiler unit + direct-vs-
+#                             #   chain bit-equivalence tests (incl.
+#                             #   nonzero alignments), the LOUD
+#                             #   LEGAL_PAIRS^2 coverage check, the
+#                             #   *_direct comm-plan golden diffs (gemm
+#                             #   round wins + qr_lq/trsm_r/herk wins +
+#                             #   the redist_md ragged byte drop), the
+#                             #   redist_path knob + measured-constants
 #                             #   tests, the EL002 rewrite-hint smoke,
 #                             #   and redist_bench --smoke
 set -u
@@ -164,11 +168,40 @@ if [ "$what" = "all" ] || [ "$what" = "redist" ]; then
     python -m pytest tests/core/test_redist_direct.py \
         tests/analysis/test_direct_plan.py \
         tests/tune/test_redist_path_knob.py \
+        tests/tune/test_redist_constants.py \
         -q -m 'not slow' -p no:cacheprovider || rc=1
-    echo "== *_direct comm-plan goldens (one-shot round wins, 1x1 + 2x2) =="
+    echo "== LEGAL_PAIRS^2 plan coverage (compile_plan total on 2x2) =="
+    # fail LOUDLY on any legal endpoint pair the compiler cannot plan
+    # (ISSUE 13 closed the matrix: MD/CIRC endpoints included) -- a new
+    # Dist or pair added without plan support would otherwise only
+    # surface as a silent chain fallback at runtime
+    python - <<'PY' || rc=1
+import os, sys
+sys.path.insert(0, os.getcwd())
+from elemental_tpu.core.dist import LEGAL_PAIRS
+from elemental_tpu.redist.plan import compile_plan
+missing = []
+for src in LEGAL_PAIRS:
+    for dst in LEGAL_PAIRS:
+        if src == dst:
+            continue
+        if compile_plan(src, dst, (6, 5), (2, 2)) is None:
+            missing.append(f"{src} -> {dst}")
+if missing:
+    print("compile_plan returned None for LEGAL endpoint pair(s):")
+    for m in missing:
+        print(f"  {m}")
+    sys.exit(1)
+print(f"plan coverage ok ({len(LEGAL_PAIRS)}^2 endpoint pairs on 2x2)")
+PY
+    echo "== *_direct comm-plan goldens (one-shot wins, 1x1 + 2x2) =="
     JAX_PLATFORMS=cpu python -m perf.comm_audit diff gemm_a_direct || rc=1
     JAX_PLATFORMS=cpu python -m perf.comm_audit diff gemm_b_direct || rc=1
     JAX_PLATFORMS=cpu python -m perf.comm_audit diff gemm_dot_direct || rc=1
+    JAX_PLATFORMS=cpu python -m perf.comm_audit diff qr_lq_direct || rc=1
+    JAX_PLATFORMS=cpu python -m perf.comm_audit diff trsm_r_direct || rc=1
+    JAX_PLATFORMS=cpu python -m perf.comm_audit diff herk_direct || rc=1
+    JAX_PLATFORMS=cpu python -m perf.comm_audit diff redist_md_direct || rc=1
     echo "== EL002 rewrite-hint smoke (lint --fix-hint accepted, clean) =="
     JAX_PLATFORMS=cpu python -m perf.comm_audit lint gemm --fix-hint || rc=1
     echo "== redist_bench smoke (1x1, chain-vs-direct bit-match) =="
