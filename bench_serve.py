@@ -1,16 +1,22 @@
 """Serving benchmark: latency percentiles + throughput of the solver
-service (ISSUE 9).
+service, sync (ISSUE 9) and async pipelined (ISSUE 14).
 
 Prints ONE JSON line (``bench_serve/v1``)::
 
     {"schema": "bench_serve/v1", "serve_p50_ms": ..., "serve_p99_ms": ...,
      "serve_solves_per_sec": ..., "requests": N, "ok": N, "batches": ...,
-     "exec_compiles": ..., "exec_hits": ..., "grid": [r, c],
-     "backend": "cpu", "n": ..., "warmup_requests": ...}
+     "exec_compiles": ..., "exec_hits": ...,
+     "serve_async_p50_ms": ..., "serve_async_p99_ms": ...,
+     "serve_async_solves_per_sec": ..., "serve_async_speedup": ...,
+     "serve_async_exec_compiles": 0, "serve_async_batches": ...,
+     "serve_pipeline_occupancy": ..., "serve_async_payload_identical":
+     true, "grid": [r, c], "backend": "cpu", "n": ...,
+     "warmup_requests": ...}
 
-into the BENCH flow: ``tools/bench_diff.py`` gates ``serve_p99_ms``
-(lower-is-better) and ``serve_solves_per_sec`` alongside the TFLOP/s
-headlines, so a serving-latency regression fails the gate exactly like a
+into the BENCH flow: ``tools/bench_diff.py`` gates ``serve_p99_ms`` /
+``serve_async_p99_ms`` (lower-is-better) and ``serve_solves_per_sec`` /
+``serve_async_solves_per_sec`` alongside the TFLOP/s headlines, so a
+serving-latency regression fails the gate exactly like a
 factorization-throughput regression.
 
 Methodology: a WARMUP pass first touches every (bucket, batch-slot)
@@ -19,6 +25,13 @@ executor cache's contract: no serving request pays compile) -- then the
 measured pass submits ``--requests`` mixed lu/hpd problems and drains.
 Latency is per-request submit->finalize wall clock as recorded in each
 ``serve_result/v1``; throughput is requests completed / drain seconds.
+The ASYNC section replays the identical workload (same seed stream)
+through :class:`AsyncSolverService` -- warmed the same way, measured
+the same way -- and additionally asserts the pipelining contract:
+``serve_async_exec_compiles == 0`` in the measured window (donated
+executables are warmed variants, not recompiles), bit-identical
+solutions and semantically identical ``serve_result/v1`` payloads vs
+the sync pass, and no leaked worker thread after shutdown.
 
 Flags: ``--requests N`` (default 64), ``--n N`` (system size, default
 96), ``--grid RxC``, ``--seed S``, ``--smoke`` (tiny sizes + schema
@@ -39,12 +52,20 @@ def _percentile(sorted_vals, q: float):
     return sorted_vals[idx]
 
 
+#: serve_result/v1 keys that must be IDENTICAL sync vs async for the
+#: same request (timing keys excluded -- latency/seconds are wall clock)
+_SEM_KEYS = ("op", "n", "nrhs", "bucket", "status", "path", "rung",
+             "residual", "tol", "retries", "bisected", "timed_out")
+
+
 def run_bench(requests: int, n: int, grid_spec, seed: int) -> dict:
+    import threading
+
     import numpy as np
     from perf.trace import _grid
     from perf.serve import _workload
     from elemental_tpu.obs import metrics as _metrics
-    from elemental_tpu.serve import SolverService
+    from elemental_tpu.serve import AsyncSolverService, SolverService
 
     grid = _grid(grid_spec)
     svc = SolverService(grid)
@@ -60,9 +81,10 @@ def run_bench(requests: int, n: int, grid_spec, seed: int) -> dict:
 
     with _metrics.scoped() as reg:
         work = _workload(rng, requests, n)
+        rids = []
         t0 = time.perf_counter()
         for op, A, B in work:
-            svc.submit(op, A, B)
+            rids.append(svc.submit(op, A, B))
         docs = svc.drain()
         wall = time.perf_counter() - t0
         events: dict = {}
@@ -75,15 +97,76 @@ def run_bench(requests: int, n: int, grid_spec, seed: int) -> dict:
 
     lats = sorted(d["latency_s"] for d in docs.values())
     ok = sum(d["status"] == "ok" for d in docs.values())
+    sps = len(docs) / wall if wall > 0 else None
+
+    # ---- async pipelined pass: the IDENTICAL workload (replayed seed
+    # stream) through AsyncSolverService, warmed the same way.  Where
+    # the backend donates (donation_safe), the __donated executables
+    # are distinct cache variants and the async warmup pays its own
+    # compiles; either way the measured window must show zero.
+    front = AsyncSolverService(SolverService(grid), donate=True)
+    rng2 = np.random.default_rng(seed)
+    warm2 = _workload(rng2, requests, n)
+    for f in [front.submit(op, A, B) for op, A, B in warm2]:
+        f.result()
+    with _metrics.scoped() as reg2:
+        work2 = _workload(rng2, requests, n)
+        t1 = time.perf_counter()
+        futs = [front.submit(op, A, B) for op, A, B in work2]
+        outs = [f.result() for f in futs]
+        wall2 = time.perf_counter() - t1
+        compiles2 = sum(
+            v for (name, labels), v in
+            reg2.counters("serve_exec_cache_events").items()
+            if dict(labels).get("event") == "compile")
+        batches2 = sum(v for (name, labels), v
+                       in reg2.counters("serve_batches").items())
+    stats = front.pipeline_stats()
+    front.shutdown(drain=True)
+    leak = any(t.name == "elemental-serve-worker" and t.is_alive()
+               for t in threading.enumerate())
+
+    # bit-identical payloads: same solutions, same serve_result/v1
+    # semantics per request (sync rids and async futures are both in
+    # submission order over the same replayed workload)
+    identical = len(rids) == len(futs)
+    for rid, fut, (x2, d2) in zip(rids, futs, outs):
+        d1 = docs[rid]
+        if any(d1.get(k) != d2.get(k) for k in _SEM_KEYS):
+            identical = False
+            break
+        p1 = (d1.get("dispatch") or {}).get("route")
+        p2 = (d2.get("dispatch") or {}).get("route")
+        x1 = svc.solutions.get(rid)
+        same_x = (x1 is None and x2 is None) or (
+            x1 is not None and x2 is not None
+            and x1.dtype == x2.dtype and np.array_equal(x1, x2))
+        if p1 != p2 or not same_x:
+            identical = False
+            break
+
+    lats2 = sorted(d["latency_s"] for _, d in outs)
+    ok2 = sum(d["status"] == "ok" for _, d in outs)
+    sps2 = len(outs) / wall2 if wall2 > 0 else None
     import jax
     return {
         "schema": BENCH_SERVE_SCHEMA,
         "serve_p50_ms": 1e3 * _percentile(lats, 0.50),
         "serve_p99_ms": 1e3 * _percentile(lats, 0.99),
-        "serve_solves_per_sec": len(docs) / wall if wall > 0 else None,
+        "serve_solves_per_sec": sps,
         "requests": len(docs), "ok": ok, "batches": int(batches),
         "exec_compiles": int(events.get("compile", 0)),
         "exec_hits": int(events.get("hit", 0)),
+        "serve_async_p50_ms": 1e3 * _percentile(lats2, 0.50),
+        "serve_async_p99_ms": 1e3 * _percentile(lats2, 0.99),
+        "serve_async_solves_per_sec": sps2,
+        "serve_async_speedup": (sps2 / sps) if sps and sps2 else None,
+        "serve_async_ok": ok2,
+        "serve_async_exec_compiles": int(compiles2),
+        "serve_async_batches": int(batches2),
+        "serve_pipeline_occupancy": stats["occupancy"],
+        "serve_async_payload_identical": bool(identical),
+        "serve_async_thread_leak": bool(leak),
         "grid": [grid.height, grid.width],
         "backend": jax.default_backend(), "n": n,
         "warmup_requests": len(warm),
@@ -122,12 +205,26 @@ def main(argv=None) -> int:
     doc = run_bench(requests, n, grid_spec, seed)
     print(json.dumps(doc))
     if smoke:
-        # schema sanity: the gateable keys must be present and numeric
+        # schema sanity: the gateable keys must be present and numeric,
+        # and the async pipelining contract must hold even at tiny sizes
         bad = [k for k in ("serve_p50_ms", "serve_p99_ms",
-                           "serve_solves_per_sec")
+                           "serve_solves_per_sec", "serve_async_p50_ms",
+                           "serve_async_p99_ms",
+                           "serve_async_solves_per_sec",
+                           "serve_pipeline_occupancy")
                if not isinstance(doc.get(k), (int, float))]
-        if bad or doc["ok"] != doc["requests"]:
+        contract = []
+        if doc["serve_async_exec_compiles"] != 0:
+            contract.append("async measured window compiled")
+        if not doc["serve_async_payload_identical"]:
+            contract.append("sync/async payloads differ")
+        if doc["serve_async_thread_leak"]:
+            contract.append("worker thread leaked")
+        if doc["serve_async_ok"] != doc["requests"]:
+            contract.append("async requests not all ok")
+        if bad or contract or doc["ok"] != doc["requests"]:
             print(f"# bench_serve smoke FAILED: bad={bad} "
+                  f"contract={contract} "
                   f"ok={doc['ok']}/{doc['requests']}", file=sys.stderr)
             return 1
         print("# bench_serve smoke: ok", file=sys.stderr)

@@ -155,6 +155,30 @@ def _redist_md_spec(variant="", redist_path=None):
                       build)
 
 
+def _redist_circ_spec(variant=""):
+    """[MC,MR] -> [CIRC,CIRC] -> [VC,STAR]: both root-only endpoint
+    legs (gather to root, scatter from root), landing on a THIRD pair
+    so the lint does not read it as a redundant round trip.  Since
+    ISSUE 14 both legs ride the jitted shard_map path (ONE fused gather
+    chain to [STAR,STAR] + a root ``device_put`` out; a broadcast
+    ``device_put`` + zero-collective local filter back), so the whole
+    chain must trace WITHOUT an eager host sync -- this driver existing
+    at all pins that (the former eager bridge could not be abstractly
+    traced)."""
+    def build(grid, n, nb, dtype):
+        from ..core.dist import Dist
+        from ..redist.engine import redistribute
+        CIRC, VC, STAR = Dist.CIRC, Dist.VC, Dist.STAR
+
+        def fn(a):
+            A = _as_dm(a, grid, n, n)
+            B = redistribute(A, CIRC, CIRC)
+            return redistribute(B, VC, STAR)
+        return fn, (_mcmr_input(grid, n, n, dtype),), {}
+    return DriverSpec(f"redist_circ_{variant}" if variant
+                      else "redist_circ", build)
+
+
 def _cholesky_spec(variant, lookahead, crossover, comm_precision=None,
                    abft=False):
     def build(grid, n, nb, dtype):
@@ -263,6 +287,10 @@ def _registry() -> dict:
         # full-mesh exchange; see tests/analysis/test_direct_plan.py)
         _redist_md_spec(),
         _redist_md_spec(variant="direct", redist_path="direct"),
+        # ISSUE 14: the CIRC endpoints folded into the jitted shard_map
+        # path -- the round-trip traces abstractly (impossible with the
+        # old eager bridge) and its golden pins the fused gather rounds
+        _redist_circ_spec(),
     ]
     return {s.name: s for s in specs}
 

@@ -1386,9 +1386,11 @@ def redistribute(A: DistMatrix, cdist: Dist, rdist: Dist,
     plan slot, so the narrow payload rides ANY pair's single collective
     -- not just the gather-to-[STAR,STAR] family.
 
-    CIRC conversions (root-only storage) run EAGERLY at this edge via the
-    global bridges plus cross-device ``device_put`` (copy::Gather /
-    copy::Scatter) -- they cannot live inside jit/shard_map."""
+    CIRC conversions (root-only storage) route their collective leg
+    through the SAME compiled ``_redistribute_jit`` as every other pair
+    (copy::Gather fuses to one gather chain to ``[STAR,STAR]``;
+    copy::Scatter is a zero-collective local filter); only the root-edge
+    ``device_put`` itself stays outside the shard_map."""
     _check_pair(cdist, rdist)
     if path not in REDIST_PATHS:
         raise ValueError(f"path must be one of {REDIST_PATHS}, got {path!r}")
@@ -1469,20 +1471,36 @@ def redistribute(A: DistMatrix, cdist: Dist, rdist: Dist,
 
 def _redistribute_circ(A: DistMatrix, cdist: Dist, rdist: Dist,
                        calign: int, ralign: int) -> DistMatrix:
-    from ..core.distmatrix import from_global, to_global
+    """CIRC endpoints via the JITTED shard_map path (ISSUE 14 satellite).
+
+    PR 9-13 ran these through the eager global bridges (``to_global`` /
+    ``from_global``: per-dimension index-map gathers executed op-by-op,
+    whose implicit cross-device resharding paid a host sync at this
+    edge -- the ROADMAP's ``'bridge'`` leftover).  Both directions now
+    route every collective through the SAME compiled ``_redistribute_jit``
+    as the non-CIRC pairs -- ``[STAR,STAR]`` storage IS the global array
+    (identity index maps), so only a root ``device_put`` remains at the
+    edge:
+
+      * dst CIRC: ONE fused gather chain to ``[STAR,STAR]``, then a
+        comm-free root-local ``device_put`` (``copy::Gather``);
+      * src CIRC: root-broadcast ``device_put`` (``copy::Scatter``),
+        then a ZERO-collective jitted local filter to the target pair.
+    """
     import jax.sharding as jsh
     g = A.grid
     if A.cdist is CIRC and cdist is CIRC:
         return A
     if cdist is CIRC:
-        arr = to_global(A)               # device computation on storage
+        star = _redistribute_jit(A, STAR, STAR, 0, 0, None)
         arr = jax.device_put(
-            arr, jsh.SingleDeviceSharding(g.mesh.devices.flat[0]))
+            star.local, jsh.SingleDeviceSharding(g.mesh.devices.flat[0]))
         return DistMatrix(arr, A.gshape, CIRC, CIRC, 0, 0, g)
-    # CIRC source: broadcast the root array, then scatter normally
+    # CIRC source: broadcast the root array, wrap it as [STAR,STAR]
+    # (identity storage form), then filter locally inside the jitted path
     arr = jax.device_put(A.local, g.sharding(jax.sharding.PartitionSpec()))
-    return from_global(arr, cdist, rdist, grid=g,
-                       calign=calign, ralign=ralign)
+    star = DistMatrix(arr, A.gshape, STAR, STAR, 0, 0, g)
+    return _redistribute_jit(star, cdist, rdist, calign, ralign, None)
 
 
 @partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))

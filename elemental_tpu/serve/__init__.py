@@ -19,6 +19,11 @@ into a system that survives production traffic -- the ROADMAP's
   :mod:`.service`    :class:`SolverService` -- submit/drain, trusted
                      per-request certification, bisect fault isolation,
                      escalation through ``certified_solve(deadline=)``
+  :mod:`.async_front` :class:`AsyncSolverService` -- the ISSUE-14
+                     pipelined front: one worker thread double-buffers
+                     host staging against device execution (donated
+                     batch buffers), completions stream as
+                     :class:`ServeFuture` resolutions
   :mod:`.chaos`      the acceptance-matrix harness over the ISSUE-7
                      ``FaultPlan`` machinery
 
@@ -29,21 +34,28 @@ CLI: ``python -m perf.serve {run,smoke,chaos}``; bench:
 from .admission import (REJECT_SCHEMA, AdmissionController, Bucket,
                         Deadline, SolveRequest, make_bucket, reject_doc)
 from .executor import (EXEC_SCHEMA, ExecutableCache, Executor, batch_slots,
-                       pad_problem, residual)
+                       ls_residual, pad_problem, pad_problem_ls, residual,
+                       route_for, tune_token)
 from .policy import (CLOSED, HALF_OPEN, OPEN, CircuitBreaker, RetryPolicy,
                      select_ladder)
 from .service import RESULT_SCHEMA, SolverService
+from .async_front import (AsyncSolverService, ServeFuture,
+                          donation_safe, serve_async)
 from .chaos import (CHAOS_SCHEMA, build_workload, chaos_matrix,
-                    replay_identical, run_cell, run_qr_cell)
+                    replay_identical, run_async_cell,
+                    run_async_shutdown_cell, run_cell, run_qr_cell)
 
 __all__ = [
     "REJECT_SCHEMA", "AdmissionController", "Bucket", "Deadline",
     "SolveRequest", "make_bucket", "reject_doc",
     "EXEC_SCHEMA", "ExecutableCache", "Executor", "batch_slots",
-    "pad_problem", "residual",
+    "ls_residual", "pad_problem", "pad_problem_ls", "residual",
+    "route_for", "tune_token",
     "CLOSED", "HALF_OPEN", "OPEN", "CircuitBreaker", "RetryPolicy",
     "select_ladder",
     "RESULT_SCHEMA", "SolverService",
+    "AsyncSolverService", "ServeFuture", "serve_async",
+    "donation_safe",
     "CHAOS_SCHEMA", "build_workload", "chaos_matrix", "replay_identical",
-    "run_cell", "run_qr_cell",
+    "run_async_cell", "run_async_shutdown_cell", "run_cell", "run_qr_cell",
 ]

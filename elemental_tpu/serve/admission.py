@@ -93,26 +93,50 @@ class Deadline:
 
 @dataclasses.dataclass(frozen=True)
 class Bucket:
-    """One canonical serve geometry: (op, padded dims, dtype)."""
-    op: str                      # "lu" | "hpd"
-    n: int                       # pow2-bucketed system size
+    """One canonical serve geometry: (op, padded dims, dtype).
+
+    Square solves (lu/hpd) carry ``n x nrhs``; tall-skinny least-squares
+    requests (``op='lstsq'``, ISSUE 14) additionally carry ``m`` -- the
+    padded ROW count -- so the key/geometry vocabulary stays backward
+    compatible for the square ops (``m is None``)."""
+    op: str                      # "lu" | "hpd" | "lstsq"
+    n: int                       # pow2-bucketed system size (columns)
     nrhs: int                    # pow2-bucketed right-hand-side count
     dtype: str
+    m: int | None = None         # lstsq only: padded row count
 
     def key(self) -> str:
         """Cache-key string, same style as ``tuning_cache/v1`` filenames."""
+        if self.m is not None:
+            return f"{self.op}__b{self.m}x{self.n}x{self.nrhs}__{self.dtype}"
         return f"{self.op}__b{self.n}x{self.nrhs}__{self.dtype}"
 
     def solve_flops(self) -> float:
         """Factor + solve flops of ONE padded problem (the cost seed)."""
         n, k = float(self.n), float(self.nrhs)
+        if self.op == "lstsq":
+            m = float(self.m)
+            return 2.0 * m * n * n + 2.0 * m * n * k   # QR + apply/solve
         factor = (n ** 3) / 3.0 if self.op == "hpd" else 2.0 * (n ** 3) / 3.0
         return factor + 2.0 * n * n * k
 
 
-def make_bucket(op: str, n: int, nrhs: int, dtype) -> Bucket:
-    """Bucket a concrete request geometry (pow2 per dim, tuner-aligned)."""
+def make_bucket(op: str, n: int, nrhs: int, dtype,
+                m: int | None = None) -> Bucket:
+    """Bucket a concrete request geometry (pow2 per dim, tuner-aligned).
+
+    For ``op='lstsq'`` pass the raw row count ``m``: columns bucket to
+    ``N = pow2(n)`` first, rows to ``M = pow2(m + (N - n))`` -- the extra
+    ``N - n`` rows are where the executor's identity pad lives (see
+    ``executor.pad_problem_ls``), so every request of the bucket embeds
+    losslessly whatever its raw shape."""
     bn, brhs = shape_bucket((int(n), max(int(nrhs), 1)))
+    if op == "lstsq":
+        if m is None:
+            raise ValueError("lstsq buckets need the row count m")
+        (bm,) = shape_bucket((int(m) + int(bn) - int(n),))
+        return Bucket(op=op, n=int(bn), nrhs=int(brhs),
+                      dtype=np.dtype(dtype).name, m=int(bm))
     return Bucket(op=op, n=int(bn), nrhs=int(brhs), dtype=np.dtype(dtype).name)
 
 
@@ -204,21 +228,27 @@ class AdmissionController:
         only known after validation, so a queue-owning caller passes its
         depth lookup)."""
         op = "hpd" if op == "cholesky" else op
-        if op not in ("lu", "hpd"):
-            return reject_doc("bad_request",
-                              detail=f"op must be 'lu' or 'hpd', got {op!r}")
+        op = "lstsq" if op == "qr" else op
+        if op not in ("lu", "hpd", "lstsq"):
+            return reject_doc(
+                "bad_request",
+                detail=f"op must be 'lu', 'hpd' or 'lstsq', got {op!r}")
         A = np.asarray(A)
         B = np.asarray(B)
         if B.ndim == 1:
             B = B[:, None]
-        if A.ndim != 2 or A.shape[0] != A.shape[1] or B.ndim != 2 \
-                or B.shape[0] != A.shape[0]:
+        square_ok = A.ndim == 2 and A.shape[0] == A.shape[1]
+        tall_ok = A.ndim == 2 and A.shape[0] >= A.shape[1]
+        shape_ok = (tall_ok if op == "lstsq" else square_ok) \
+            and B.ndim == 2 and B.shape[0] == A.shape[0]
+        if not shape_ok:
             return reject_doc("bad_request",
                               detail=f"bad shapes A{A.shape} B{B.shape}")
         if not np.issubdtype(A.dtype, np.inexact):
             A = A.astype(np.float64)
             B = B.astype(np.float64)
-        bucket = make_bucket(op, A.shape[0], B.shape[1], A.dtype)
+        bucket = make_bucket(op, A.shape[1], B.shape[1], A.dtype,
+                             m=A.shape[0] if op == "lstsq" else None)
         if callable(queue_depth):
             queue_depth = int(queue_depth(bucket))
         if deadline is not None:

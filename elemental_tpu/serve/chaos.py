@@ -35,6 +35,17 @@ serve admission only solves lu/hpd, so the qr cells drive
 ``qr(..., health=True)`` directly under the same fault axes and grade
 detection against the ISSUE-9 health parity (see
 :data:`QR_DETECTED_KINDS` for the honest contract).
+
+ISSUE 14 grows an **async** column: :func:`run_async_cell` drives the
+pipelined :class:`~.async_front.AsyncSolverService` with TWO batches in
+flight so the fault lands mid-pipeline -- batch 0's device output is
+corrupted while batch 1 is already staged/dispatched behind it -- and
+pins that the damage never leaks into the neighbor batch.  The same
+grading applies (the worker thread is deterministic: single consumer,
+FIFO batch pop, so seeded plans replay).  :func:`run_async_shutdown_cell`
+pins ``shutdown(drain=False)`` under load: every future resolves, every
+unexecuted request gets a STRUCTURED ``serve_reject/v1`` shutdown
+reject, zero silent drops.
 """
 from __future__ import annotations
 
@@ -43,6 +54,8 @@ import numpy as np
 from ..resilience.faults import (FAULT_KINDS, FaultPlan, FaultSpec,
                                  logs_identical)
 from ..redist.engine import fault_injection
+from .admission import REJECT_SCHEMA
+from .async_front import AsyncSolverService
 from .executor import residual
 from .service import SolverService
 
@@ -192,6 +205,141 @@ def _classify(svc, plan, workload, ids, *, kind, target, mode, op,
             "verdict": verdict, "violations": violations}
 
 
+def run_async_cell(grid, *, kind: str, mode: str, target: str = "compute",
+                   op: str | None = None, n: int = 16, nrhs: int = 2,
+                   requests: int = 8, call: int = 0, nelem: int = 2,
+                   seed: int = 13, budget_s: float | None = None,
+                   service_kw: dict | None = None):
+    """One async-column cell: the fault lands MID-PIPELINE.
+
+    ``requests`` split into two batches (``max_batch = requests // 2``)
+    so that when batch 0's solve output crosses the compute seam
+    (call 0), batch 1 is already staged and dispatched behind it on the
+    device queue.  The front is built with ``autostart=False`` and the
+    whole workload pre-loaded before the worker starts, which fixes
+    batch membership -- the cell is deterministic and seeded plans
+    replay.  Grading is the sync :func:`_classify` verbatim: a one-shot
+    fault in batch 0 must leave every batch-1 (neighbor) request ``ok``
+    -- anything else is ``collateral``.  Returns
+    ``(cell_doc, plan, front)``."""
+    op = op or _OP_FOR_TARGET[target]
+    fastpath = target == "compute"
+    batch = max(requests // 2, 1)
+    svc = make_service(grid, fastpath=fastpath, requests=batch,
+                       **(service_kw or {}))
+    front = AsyncSolverService(svc, donate=True, autostart=False)
+    workload = build_workload(op, n, nrhs, requests, seed)
+    plan = FaultPlan(seed=seed, faults=[
+        FaultSpec(target, kind, call=call, every=(mode == "persistent"),
+                  nelem=nelem)])
+    futs = [front.submit(op, A, B, budget_s=budget_s)
+            for A, B in workload]
+    with fault_injection(plan):
+        front.start()
+        front.shutdown(drain=True)
+    ids = [f.id for f in futs]   # assigned at worker ingest; join'd now
+    cell = _classify(svc, plan, workload, ids, kind=kind, target=target,
+                     mode=mode, op=op, budget_s=budget_s)
+    cell["column"] = "async"
+    cell["batches"] = -(-requests // batch)
+    for f in futs:
+        if not f.done():                        # zero silent drops
+            cell["violations"].append(
+                {"kind": "silent_drop", "id": f.id,
+                 "detail": "future never resolved through drain"})
+    return cell, plan, front
+
+
+def run_async_shutdown_cell(grid, *, n: int = 16, nrhs: int = 2,
+                            requests: int = 12, seed: int = 13,
+                            service_kw: dict | None = None):
+    """``shutdown(drain=False)`` under load: structured flush, no drops.
+
+    Three batches of work; a gate callback PARKS the worker inside
+    batch 0's completion -- at which point batch 1 is already dispatched
+    (double buffering stages k+1 before collecting k) and batch 2 still
+    queued -- then hard-stops.  Deterministic pins: batch 0 and the
+    in-flight batch 1 complete ``ok``; batch 2 flushes with structured
+    ``serve_reject/v1`` ``reason="shutdown"`` rejects; every future
+    resolves (zero silent drops); a post-shutdown submit rejects
+    immediately.  Returns ``(cell_doc, front)``."""
+    import threading
+    batch = max(requests // 3, 1)
+    svc = make_service(grid, fastpath=True, requests=batch,
+                       **(service_kw or {}))
+    front = AsyncSolverService(svc, donate=True, autostart=False)
+    workload = build_workload("hpd", n, nrhs, requests, seed)
+    futs = [front.submit("hpd", A, B) for A, B in workload]
+    parked, go = threading.Event(), threading.Event()
+
+    def _gate(_fut):                    # fires on the worker thread
+        parked.set()
+        go.wait(timeout=120.0)
+
+    futs[0].add_done_callback(_gate)
+    front.start()
+    assert parked.wait(timeout=120.0), "worker never reached batch 0"
+    # worker is parked mid-completion of batch 0; batch 1 is on device.
+    # Flip the stop flags BEFORE releasing it so the very next loop
+    # iteration takes the emergency-stop path (GIL makes the writes
+    # visible); shutdown() is idempotent and just joins.
+    front._stop, front._drain = True, False
+    go.set()
+    front.shutdown(drain=False)
+    violations = []
+    outcomes = {}
+    n_ok = n_flush = 0
+    for f, (A, B) in zip(futs, workload):
+        if not f.done():
+            violations.append({"kind": "silent_drop", "id": f.id,
+                               "detail": "future unresolved after "
+                                         "shutdown(drain=False)"})
+            outcomes[f.id] = "dropped"
+            continue
+        X, doc = f.result(timeout=0)
+        if doc.get("schema") == REJECT_SCHEMA:
+            outcomes[f.id] = f"reject:{doc.get('reason')}"
+            if doc.get("reason") != "shutdown":
+                violations.append({"kind": "unstructured", "id": f.id,
+                                   "detail": f"flushed with reason "
+                                             f"{doc.get('reason')!r}"})
+            else:
+                n_flush += 1
+        elif doc.get("status") == "ok":
+            outcomes[f.id] = "ok"
+            n_ok += 1
+            if X is None or residual(A, B, X) > doc["tol"]:
+                violations.append({"kind": "silent_garbage", "id": f.id,
+                                   "detail": "ok result fails the "
+                                             "trusted residual"})
+        else:
+            outcomes[f.id] = doc.get("status", "?")
+            violations.append({"kind": "unstructured", "id": f.id,
+                               "detail": "neither ok nor a shutdown "
+                                         "reject under hard stop"})
+    if not violations and n_ok + n_flush != requests:
+        violations.append({"kind": "unstructured",
+                           "detail": "outcome ledger does not cover "
+                                     "the workload"})
+    if n_flush == 0:
+        violations.append({"kind": "vacuous",
+                           "detail": "hard stop flushed nothing -- the "
+                                     "under-load pin is unexercised"})
+    late = front.submit("hpd", *workload[0])
+    _, late_doc = late.result(timeout=5.0)
+    if late_doc.get("schema") != REJECT_SCHEMA \
+            or late_doc.get("reason") != "shutdown":
+        violations.append({"kind": "unstructured",
+                           "detail": "post-shutdown submit not rejected "
+                                     "with reason='shutdown'"})
+    return {"kind": "shutdown", "target": "pipeline",
+            "mode": "drain_false", "op": "hpd", "column": "async",
+            "requests": requests, "ok": n_ok, "flushed": n_flush,
+            "fired": 0, "budget_s": None, "outcomes": outcomes,
+            "verdict": "isolated" if not violations else "surfaced",
+            "violations": violations}, front
+
+
 #: the qr column's detection contract (ISSUE 11, riding ISSUE 9's
 #: qr health parity): 'nan' is caught by the nonfinite scan and 'scale'
 #: (x1e12) by the growth estimate -- a SILENT undetected corruption for
@@ -255,12 +403,17 @@ def run_qr_cell(grid, *, kind: str, target: str, n: int = 24,
 
 def chaos_matrix(grid, *, kinds=FAULT_KINDS, targets=CHAOS_TARGETS,
                  modes=CHAOS_MODES, seed: int = 13, n: int = 16,
-                 requests: int = 4, qr_column: bool = True, **kw):
+                 requests: int = 4, qr_column: bool = True,
+                 async_column: bool = True, **kw):
     """The full acceptance matrix -> ``chaos_report/v1``.
 
     ``qr_column=True`` (default) appends the ISSUE-11 qr op column:
     one :func:`run_qr_cell` per (kind, target), detection via the
-    ISSUE-9 health parity (see :data:`QR_DETECTED_KINDS`)."""
+    ISSUE-9 health parity (see :data:`QR_DETECTED_KINDS`).
+
+    ``async_column=True`` (default) appends the ISSUE-14 async column:
+    one mid-pipeline :func:`run_async_cell` per (kind, mode) on the
+    compute seam, plus one :func:`run_async_shutdown_cell`."""
     cells = []
     nviol = 0
     vacuous = 0
@@ -287,6 +440,23 @@ def chaos_matrix(grid, *, kinds=FAULT_KINDS, targets=CHAOS_TARGETS,
                     vacuous += 1
                 nviol += len(cell["violations"])
                 cells.append(cell)
+    if async_column:
+        for kind in kinds:
+            for mode in modes:
+                cell, _, _ = run_async_cell(
+                    grid, kind=kind, mode=mode, seed=seed, n=n,
+                    requests=2 * requests, **kw)
+                if cell["fired"] == 0:
+                    vacuous += 1
+                    cell["violations"].append(
+                        {"kind": "vacuous",
+                         "detail": "fault never landed"})
+                nviol += len(cell["violations"])
+                cells.append(cell)
+        cell, _ = run_async_shutdown_cell(grid, n=n, seed=seed,
+                                          requests=3 * requests)
+        nviol += len(cell["violations"])
+        cells.append(cell)
     return {"schema": CHAOS_SCHEMA, "grid": [grid.height, grid.width],
             "seed": seed, "cells": cells, "violations_total": nviol,
             "vacuous_cells": vacuous, "ok": nviol == 0}

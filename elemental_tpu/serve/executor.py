@@ -1,22 +1,51 @@
 """Batched bucket executor: vmap'd solves + a persistent AOT-compiled
 executable cache.
 
-The throughput core of the solver service (ISSUE 9).  All requests in
-one :class:`~.admission.Bucket` are PADDED to the bucket's canonical
-geometry (the system embedded top-left, identity on the padded diagonal,
-zero right-hand sides -- the padded solution's extra rows are exactly
-zero, so truncation is lossless), stacked, and solved by ONE dispatch of
-a ``jax.vmap``'d Cholesky/LU kernel: hundreds of small systems amortize
-one launch, exactly the serving workload the ROADMAP names.
+The throughput core of the solver service (ISSUE 9, async-pipelined in
+ISSUE 14).  All requests in one :class:`~.admission.Bucket` are PADDED
+to the bucket's canonical geometry (the system embedded top-left,
+identity on the padded diagonal, zero right-hand sides -- the padded
+solution's extra rows are exactly zero, so truncation is lossless),
+stacked, and solved by ONE dispatch of a ``jax.vmap``'d
+Cholesky/LU/QR kernel: hundreds of small systems amortize one launch,
+exactly the serving workload the ROADMAP names.
+
+The batch path is split into three stages so an async front-end can
+overlap them across batches (ISSUE 14 tentpole):
+
+  * :meth:`Executor.stage`    -- host work: pad/stack + executable lookup
+  * :meth:`Executor.dispatch` -- device launch; returns BEFORE the device
+    finishes (jax async dispatch), so the host is free to stage batch
+    k+1 while batch k runs
+  * :meth:`Executor.collect`  -- ``block_until_ready`` + fault seam +
+    host truncation + certifiable float64 slices
+
+``Executor.run`` is the synchronous composition of the three and keeps
+PR-9 semantics bit-for-bit.  With ``donate=True`` the compiled batch
+executable is built with ``donate_argnums=(0, 1)``: steady-state serving
+re-uses the batch buffers instead of allocating (on backends where an
+operand can alias the output -- the B operand here; the A operand never
+can, which jax reports as an ignorable "donated buffers were not
+usable" warning, suppressed at compile time).
 
 No request ever pays compile: executables are AOT-lowered and compiled
-ONCE per ``(op, bucket, batch-slot, dtype, backend)`` key -- the same
-key vocabulary as ``tuning_cache/v1`` -- and cached for the life of the
-process (``serve_exec_cache/v1``; hits/misses/compiles are counted on
-the obs metrics registry as ``serve_exec_cache_events``).  Batch sizes
-are pow2-bucketed too (``batch_slots``), so a queue draining 3, 5, then
-6 requests reuses the 4- and 8-slot executables instead of compiling
-three shapes.
+ONCE per ``(op, bucket, batch-slot, dtype, backend, tuner-provenance,
+donation)`` key -- the geometry part is the same key vocabulary as
+``tuning_cache/v1`` -- and cached for the life of the process
+(``serve_exec_cache/v1``; hits/misses/compiles are counted on the obs
+metrics registry as ``serve_exec_cache_events``).  Batch sizes are
+pow2-bucketed too (``batch_slots``), so a queue draining 3, 5, then 6
+requests reuses the 4- and 8-slot executables instead of compiling
+three shapes.  The tuner-provenance component (:func:`tune_token`) is a
+digest of the resolved tuning-cache winner for the mapped driver op, so
+a tuner re-sweep (every ``tune.cache.save``/``clear`` bumps the
+in-process epoch) can never serve a stale executable.
+
+Dispatch is tuner-fed (:func:`route_for`): when the tuning cache holds a
+MEASURED winner for the mapped distributed driver whose seconds beat the
+replicated vmap path's per-request estimate, the request leaves the
+batch path for the grid path -- and either way the decision lands in
+``serve_result/v1`` provenance.
 
 The batch output routes through the engine's ``'compute'`` fault seam
 (:func:`~elemental_tpu.redist.engine.apply_fault`) before certification,
@@ -25,19 +54,30 @@ serve-side twin of the driver panel seams.
 
 Certification is the same TRUSTED measurement ``certified_solve`` uses:
 host-side float64 residuals per request (a corrupted executor can
-corrupt the solve, never the measurement).
+corrupt the solve, never the measurement).  Least-squares requests
+certify on the normal-equations residual (:func:`ls_residual`), which
+vanishes at the LS minimizer even when ``B - A X`` cannot.
 """
 from __future__ import annotations
 
+import dataclasses
+import json
 import time
+import warnings
+import zlib
 
 import numpy as np
 
 from ..obs import metrics as _metrics
 from ..redist.engine import apply_fault
+from ..tune import cache as _tune
 from .admission import Bucket
 
 EXEC_SCHEMA = "serve_exec_cache/v1"
+
+#: serve op -> distributed-driver op in the ``tuning_cache/v1`` vocabulary
+#: (what :func:`tune_token` digests and :func:`route_for` consults)
+DRIVER_OPS = {"hpd": "cholesky", "lu": "lu", "lstsq": "qr"}
 
 
 def batch_slots(k: int) -> int:
@@ -61,6 +101,28 @@ def pad_problem(A: np.ndarray, B: np.ndarray, bucket: Bucket):
     return Ap, Bp
 
 
+def pad_problem_ls(A: np.ndarray, B: np.ndarray, bucket: Bucket):
+    """Embed one (m, n) least-squares problem into the bucket geometry.
+
+    ``Ap[:m, :n] = A`` and an identity block fills the EXTRA columns in
+    the EXTRA rows: ``Ap[m : m + (N - n), n:] = I``.  The pad columns
+    are therefore orthogonal to A's columns, the padded normal equations
+    decouple, and ``Xp[:n]`` is exactly the original LS minimizer
+    (``Xp[n:] = 0`` since the pad rows of B are zero).  ``make_bucket``
+    guarantees ``M >= m + (N - n)`` so the identity always fits."""
+    m, n = A.shape
+    nrhs = B.shape[1]
+    dt = np.dtype(bucket.dtype)
+    N, M = bucket.n, bucket.m
+    Ap = np.zeros((M, N), dtype=dt)
+    Ap[:m, :n] = A
+    if N > n:
+        Ap[m:m + (N - n), n:] = np.eye(N - n, dtype=dt)
+    Bp = np.zeros((M, bucket.nrhs), dtype=dt)
+    Bp[:m, :nrhs] = B
+    return Ap, Bp
+
+
 def _kernel(op: str):
     """The one-problem solve kernel ``(A, B) -> X`` that gets vmapped."""
     import jax
@@ -76,15 +138,103 @@ def _kernel(op: str):
             y = jax.scipy.linalg.solve_triangular(L, b, lower=True)
             return jax.scipy.linalg.solve_triangular(
                 jnp.conj(L).T, y, lower=False)
+    elif op == "lstsq":
+        def solve(a, b):
+            q, r = jnp.linalg.qr(a, mode="reduced")
+            return jax.scipy.linalg.solve_triangular(
+                r, jnp.conj(q).T @ b, lower=False)
     else:
-        raise ValueError(f"executor op must be 'lu' or 'hpd', got {op!r}")
+        raise ValueError(
+            f"executor op must be 'lu', 'hpd' or 'lstsq', got {op!r}")
     return solve
+
+
+#: memoized tuner-provenance tokens: (cache_dir, driver_op, dims, dtype,
+#: backend) -> (tune-cache epoch, token).  Recomputed only when the
+#: in-process tuning-cache write generation moves (ISSUE 14 satellite:
+#: a re-sweep invalidates without a file read per batch).
+_TOKEN_MEMO: dict = {}
+
+
+def _bucket_dims(bucket: Bucket) -> tuple:
+    return (bucket.m, bucket.n) if bucket.m is not None \
+        else (bucket.n, bucket.n)
+
+
+def tune_token(op: str, bucket: Bucket, backend: str) -> str:
+    """Digest of the resolved tuning-cache winner for this geometry.
+
+    Empty string when the mapped driver op has no cache entry (the
+    common cold case -- executable keys stay byte-identical to PR 9).
+    Otherwise a crc32 over the winner's config/created/source, so any
+    re-sweep that changes the resolved knobs changes the executable key
+    and forces a fresh compile instead of serving a stale binary."""
+    driver_op = DRIVER_OPS.get(op)
+    if driver_op is None:
+        return ""
+    dims = _bucket_dims(bucket)
+    memo_key = (_tune.cache_dir(), driver_op, dims, bucket.dtype, backend)
+    ep = _tune.epoch()
+    cached = _TOKEN_MEMO.get(memo_key)
+    if cached is not None and cached[0] == ep:
+        return cached[1]
+    doc = _tune.load(
+        _tune.make_key(driver_op, dims, bucket.dtype, (1, 1), backend))
+    if doc is None:
+        token = ""
+    else:
+        blob = json.dumps(
+            [doc.get("config"), doc.get("created"), doc.get("source")],
+            sort_keys=True)
+        token = format(zlib.crc32(blob.encode()), "08x")
+    _TOKEN_MEMO[memo_key] = (ep, token)
+    return token
+
+
+def route_for(bucket: Bucket, grid_shape, backend: str,
+              est_vmap_s: float | None):
+    """Tuner-fed dispatch decision for ONE request of ``bucket``.
+
+    Returns ``(route, provenance)`` with route ``'vmap'`` (the batched
+    replicated path) or ``'grid'`` (the distributed driver path).  The
+    request leaves the vmap path ONLY when the tuning cache holds a
+    MEASURED winner for the mapped driver op at this geometry on
+    ``grid_shape`` whose recorded seconds strictly beat the vmap path's
+    per-request estimate (``est_vmap_s``, the admission EWMA / cold
+    flops model) -- a missing or unmeasured entry always stays on vmap,
+    so routing is deterministic on a cold cache.  The provenance dict is
+    what ``serve_result/v1`` records as its ``dispatch`` field."""
+    driver_op = DRIVER_OPS.get(bucket.op)
+    prov = {"route": "vmap", "driver_op": driver_op,
+            "grid": list(grid_shape), "source": "default",
+            "tune_token": "", "measured_s": None,
+            "vmap_est_s": None if est_vmap_s is None else float(est_vmap_s)}
+    if driver_op is None:
+        return "vmap", prov
+    prov["tune_token"] = tune_token(bucket.op, bucket, backend)
+    doc = _tune.load(_tune.make_key(driver_op, _bucket_dims(bucket),
+                                    bucket.dtype, tuple(grid_shape),
+                                    backend))
+    if doc is None or doc.get("source") != "measured":
+        return "vmap", prov
+    prov["source"] = "measured"
+    sec = (doc.get("metric") or {}).get("seconds")
+    if sec is None:
+        return "vmap", prov
+    prov["measured_s"] = float(sec)
+    if est_vmap_s is not None and float(sec) < float(est_vmap_s):
+        prov["route"] = "grid"
+        return "grid", prov
+    return "vmap", prov
 
 
 class ExecutableCache:
     """AOT-compiled batched solvers, keyed like ``tuning_cache/v1``.
 
-    One entry per ``(op, bucket, slots, dtype, backend)``; the first
+    One entry per ``(op, bucket, slots, dtype, backend)`` plus -- when
+    set -- the resolved tuner-provenance token and the donation flag
+    (ISSUE 14): a re-sweep or a donating front-end gets its OWN
+    executable instead of a stale or non-donating one.  The first
     request of a geometry pays ``lower().compile()`` ONCE, every later
     batch calls the compiled executable directly.  In-process persistent
     (executable serialization is backend-specific; the jax persistent
@@ -94,26 +244,46 @@ class ExecutableCache:
         self._cache: dict = {}
 
     @staticmethod
-    def key(op: str, bucket: Bucket, slots: int, backend: str) -> str:
-        return (f"{op}__b{bucket.n}x{bucket.nrhs}__x{slots}"
-                f"__{bucket.dtype}__{backend}")
+    def key(op: str, bucket: Bucket, slots: int, backend: str,
+            tune: str = "", donate: bool = False) -> str:
+        if bucket.m is not None:
+            geo = f"b{bucket.m}x{bucket.n}x{bucket.nrhs}"
+        else:
+            geo = f"b{bucket.n}x{bucket.nrhs}"
+        key = f"{op}__{geo}__x{slots}__{bucket.dtype}__{backend}"
+        if tune:
+            key += f"__t{tune}"
+        if donate:
+            key += "__donated"
+        return key
 
-    def get(self, op: str, bucket: Bucket, slots: int):
+    def get(self, op: str, bucket: Bucket, slots: int, *,
+            donate: bool = False):
         """The compiled batched executable for this geometry."""
         import jax
 
         backend = jax.default_backend()
-        key = self.key(op, bucket, slots, backend)
+        key = self.key(op, bucket, slots, backend,
+                       tune=tune_token(op, bucket, backend), donate=donate)
         hit = self._cache.get(key)
         if hit is not None:
             _metrics.inc("serve_exec_cache_events", op=op, event="hit")
             return hit
         _metrics.inc("serve_exec_cache_events", op=op, event="miss")
-        a = jax.ShapeDtypeStruct((slots, bucket.n, bucket.n),
+        rows = bucket.m if bucket.m is not None else bucket.n
+        a = jax.ShapeDtypeStruct((slots, rows, bucket.n),
                                  np.dtype(bucket.dtype))
-        b = jax.ShapeDtypeStruct((slots, bucket.n, bucket.nrhs),
+        b = jax.ShapeDtypeStruct((slots, rows, bucket.nrhs),
                                  np.dtype(bucket.dtype))
-        compiled = jax.jit(jax.vmap(_kernel(op))).lower(a, b).compile()
+        fn = jax.jit(jax.vmap(_kernel(op)),
+                     donate_argnums=(0, 1) if donate else ())
+        with warnings.catch_warnings():
+            # the A operand's shape can never alias the X output, so jax
+            # reports its donation as unusable; only B's aliasing is the
+            # point, and the warning is not actionable
+            warnings.filterwarnings(
+                "ignore", message=".*[Dd]onated buffers.*")
+            compiled = fn.lower(a, b).compile()
         _metrics.inc("serve_exec_cache_events", op=op, event="compile")
         self._cache[key] = compiled
         return compiled
@@ -125,44 +295,114 @@ class ExecutableCache:
         self._cache.clear()
 
 
+@dataclasses.dataclass
+class Staged:
+    """One staged batch in flight: padded operands + its executable.
+
+    Produced by :meth:`Executor.stage`; :meth:`Executor.dispatch` fills
+    ``X``/``t0`` (and drops the operand references when they were
+    donated -- they are invalid afterwards); :meth:`Executor.collect`
+    consumes it."""
+    bucket: Bucket
+    requests: list
+    compiled: object
+    a: object
+    b: object
+    donate: bool
+    X: object = None
+    t0: float = 0.0
+
+
 class Executor:
-    """Runs padded batches through the cached executables."""
+    """Runs padded batches through the cached executables.
+
+    ``run`` is the synchronous path (PR-9 semantics); the async front
+    drives the same three stages itself so batch k+1's host staging
+    overlaps batch k's device execution."""
 
     def __init__(self, *, clock=time.monotonic):
         self.cache = ExecutableCache()
         self.clock = clock
 
-    def run(self, bucket: Bucket, requests):
-        """Solve every request of one bucket in ONE batched dispatch.
+    def stage(self, bucket: Bucket, requests, *, donate: bool = False):
+        """HOST stage: pad + stack every request, look up the executable.
 
-        Returns ``(xs, seconds)``: ``xs[i]`` is request i's UNPADDED host
-        solution (float64), ``seconds`` the wall-clock of the dispatch
-        (what the admission EWMA feeds on).  The batch output crosses the
-        ``'compute'`` fault seam before truncation."""
-        import jax
+        This is the work the async pipeline overlaps with the previous
+        batch's device execution.  Returns a :class:`Staged`."""
         import jax.numpy as jnp
 
+        t0 = self.clock()
         k = len(requests)
-        if k == 0:
-            return [], 0.0
         slots = batch_slots(k)
         dt = np.dtype(bucket.dtype)
-        a = np.broadcast_to(np.eye(bucket.n, dtype=dt),
-                            (slots, bucket.n, bucket.n)).copy()
-        b = np.zeros((slots, bucket.n, bucket.nrhs), dtype=dt)
-        for i, req in enumerate(requests):
-            a[i], b[i] = pad_problem(req.A, req.B, bucket)
-        compiled = self.cache.get(bucket.op, bucket, slots)
+        if bucket.m is not None:
+            a = np.zeros((slots, bucket.m, bucket.n), dtype=dt)
+            a[:, :bucket.n, :] = np.eye(bucket.n, dtype=dt)
+            b = np.zeros((slots, bucket.m, bucket.nrhs), dtype=dt)
+            for i, req in enumerate(requests):
+                a[i], b[i] = pad_problem_ls(req.A, req.B, bucket)
+        else:
+            a = np.broadcast_to(np.eye(bucket.n, dtype=dt),
+                                (slots, bucket.n, bucket.n)).copy()
+            b = np.zeros((slots, bucket.n, bucket.nrhs), dtype=dt)
+            for i, req in enumerate(requests):
+                a[i], b[i] = pad_problem(req.A, req.B, bucket)
+        compiled = self.cache.get(bucket.op, bucket, slots, donate=donate)
+        staged = Staged(bucket=bucket, requests=list(requests),
+                        compiled=compiled, a=jnp.asarray(a),
+                        b=jnp.asarray(b), donate=donate)
+        _metrics.observe("serve_stage_seconds", self.clock() - t0,
+                         op=bucket.op, stage="stage")
+        return staged
+
+    def dispatch(self, staged: Staged) -> Staged:
+        """DEVICE launch: returns as soon as the work is enqueued (jax
+        async dispatch) -- the host is free to stage the next batch."""
         t0 = self.clock()
-        X = compiled(jnp.asarray(a), jnp.asarray(b))
+        staged.t0 = t0
+        staged.X = staged.compiled(staged.a, staged.b)
+        if staged.donate:
+            staged.a = staged.b = None       # donated: buffers are dead
+        _metrics.observe("serve_stage_seconds", self.clock() - t0,
+                         op=staged.bucket.op, stage="dispatch")
+        return staged
+
+    def collect(self, staged: Staged):
+        """Block for the device result, cross the fault seam, truncate.
+
+        Returns ``(xs, seconds)``: ``xs[i]`` is request i's UNPADDED
+        host solution (float64); ``seconds`` the dispatch->ready
+        wall-clock (what the admission EWMA feeds on)."""
+        bucket, requests = staged.bucket, staged.requests
+        X = staged.X
         X.block_until_ready()
-        seconds = self.clock() - t0
+        seconds = self.clock() - staged.t0
+        t1 = self.clock()
         X, = apply_fault("compute", (X,))
-        Xh = np.asarray(X, dtype=np.float64)
-        xs = [Xh[i, :req.n, :req.nrhs] for i, req in enumerate(requests)]
+        # OWNED copy, never a zero-copy view: on CPU ``np.asarray`` of a
+        # float64 jax array aliases the device buffer, which is freed
+        # when the batch's array drops and REUSED by a later batch --
+        # already-resolved solutions would silently mutate under the
+        # pipelined front (and latently under drain)
+        Xh = np.array(X, dtype=np.float64)
+        # the padded solution is (bucket.n, bucket.nrhs) for every op --
+        # lstsq included (QR of the (M, N) pad yields an (N, nrhs) X);
+        # a request's true solution is its A's COLUMN count deep
+        xs = [Xh[i, :req.A.shape[1], :req.nrhs]
+              for i, req in enumerate(requests)]
         _metrics.inc("serve_batches", op=bucket.op)
-        _metrics.inc("serve_batched_solves", k, op=bucket.op)
+        _metrics.inc("serve_batched_solves", len(requests), op=bucket.op)
+        _metrics.observe("serve_stage_seconds", self.clock() - t1,
+                         op=bucket.op, stage="collect")
         return xs, seconds
+
+    def run(self, bucket: Bucket, requests, *, donate: bool = False):
+        """Solve every request of one bucket in ONE batched dispatch
+        (synchronous stage -> dispatch -> collect composition)."""
+        if len(requests) == 0:
+            return [], 0.0
+        return self.collect(self.dispatch(
+            self.stage(bucket, requests, donate=donate)))
 
 
 def residual(A: np.ndarray, B: np.ndarray, X: np.ndarray) -> float:
@@ -178,4 +418,21 @@ def residual(A: np.ndarray, B: np.ndarray, X: np.ndarray) -> float:
         if not np.isfinite(den) or den == 0.0:
             return float("inf")
         res = np.linalg.norm(Bn - An @ Xn) / den
+    return float(res) if np.isfinite(res) else float("inf")
+
+
+def ls_residual(A: np.ndarray, B: np.ndarray, X: np.ndarray) -> float:
+    """TRUSTED host-float64 least-squares certificate: the scaled
+    normal-equations residual ``|A' (B - A X)| / (|A|^2 |X| + |A| |B|)``.
+    Unlike the plain residual, this vanishes at the LS minimizer even
+    when the overdetermined system leaves ``B - A X`` nonzero."""
+    An = np.asarray(A, dtype=np.float64)
+    Bn = np.asarray(B, dtype=np.float64)
+    Xn = np.asarray(X, dtype=np.float64)
+    with np.errstate(over="ignore", invalid="ignore"):
+        nA = np.linalg.norm(An)
+        den = nA * nA * np.linalg.norm(Xn) + nA * np.linalg.norm(Bn)
+        if not np.isfinite(den) or den == 0.0:
+            return float("inf")
+        res = np.linalg.norm(An.conj().T @ (Bn - An @ Xn)) / den
     return float(res) if np.isfinite(res) else float("inf")

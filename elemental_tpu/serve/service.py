@@ -38,7 +38,7 @@ from ..obs import metrics as _metrics
 from ..obs.tracer import active_tracer, phase_hook
 from ..resilience.certify import certified_solve, default_tol
 from .admission import AdmissionController, Bucket, Deadline, reject_doc
-from .executor import Executor, residual
+from .executor import Executor, ls_residual, residual, route_for
 from .policy import (DEGRADE_PRESSURE, OPEN, CircuitBreaker, RetryPolicy,
                      select_ladder)
 
@@ -84,6 +84,12 @@ class SolverService:
         self.results: dict = {}          # id -> serve_result/v1 | reject
         self.solutions: dict = {}        # id -> np.ndarray
         self._shutdown = False           # set by shutdown(); rejects submits
+        self._dispatch: dict = {}        # id -> tuner-fed routing provenance
+        #: streaming completion hook (ISSUE 14): called as
+        #: ``on_result(id, doc, x)`` the moment a request finalizes --
+        #: BEFORE drain returns -- so an async front can resolve futures
+        #: per batch.  A raising hook never poisons batch-mates.
+        self.on_result = None
 
     # ---- bookkeeping -------------------------------------------------
     def _grid(self):
@@ -115,6 +121,16 @@ class SolverService:
 
     def _tol(self, req) -> float:
         return self.tol_factor * default_tol(req.n, req.A.dtype)
+
+    def _route(self, bucket: Bucket):
+        """Tuner-fed dispatch decision for this batch's bucket (ISSUE
+        14): per-request vmap estimate from the admission EWMA vs the
+        tuning cache's measured grid winner."""
+        import jax
+        est = self.admission.estimate_batch_s(bucket) / self.max_batch
+        g = self._grid()
+        return route_for(bucket, (g.height, g.width),
+                         jax.default_backend(), est)
 
     # ---- submit ------------------------------------------------------
     def submit(self, op: str, A, B, *, budget_s: float | None = None,
@@ -153,6 +169,22 @@ class SolverService:
         self._gauges()
         return req.id
 
+    def _pop_batch(self):
+        """FIFO batch pop: the bucket whose HEAD request is oldest
+        yields up to ``max_batch`` requests; None when nothing queued."""
+        if not self._queues:
+            return None
+        bucket = min(self._queues,
+                     key=lambda b: self._queues[b][0].submitted)
+        q = self._queues[bucket]
+        batch, rest = q[:self.max_batch], q[self.max_batch:]
+        if rest:
+            self._queues[bucket] = rest
+        else:
+            del self._queues[bucket]
+        self._gauges()
+        return bucket, batch
+
     # ---- drain -------------------------------------------------------
     def drain(self) -> dict:
         """Process the queue to completion; returns {id: result doc} for
@@ -162,16 +194,11 @@ class SolverService:
         done: dict = {}
         before = set(self.results)
         bi = 0
-        while self._queues:
-            # oldest head request picks the next bucket (FIFO fairness)
-            bucket = min(self._queues,
-                         key=lambda b: self._queues[b][0].submitted)
-            q = self._queues[bucket]
-            batch, self._queues[bucket] = q[:self.max_batch], \
-                q[self.max_batch:]
-            if not self._queues[bucket]:
-                del self._queues[bucket]
-            self._gauges()
+        while True:
+            popped = self._pop_batch()
+            if popped is None:
+                break
+            bucket, batch = popped
             self._run_batch(bucket, batch, tm, bi)
             bi += 1
         for rid, doc in self.results.items():
@@ -220,6 +247,25 @@ class SolverService:
 
     # ---- the batch pipeline ------------------------------------------
     def _run_batch(self, bucket: Bucket, reqs, tm, bi: int) -> None:
+        live = self._prepare_batch(bucket, reqs)
+        if not live:
+            return
+        tr = active_tracer()
+        span = tr.span(f"serve:batch:{bucket.key()}", n=len(live)) \
+            if tr is not None else _null_cm()
+        with span:
+            xs, seconds = self.executor.run(bucket, live)
+        tm.tick("batch", bi)
+        self._complete_batch(bucket, live, xs, seconds)
+
+    def _prepare_batch(self, bucket: Bucket, reqs) -> list:
+        """Pre-execution leg of the batch pipeline: drop expired
+        requests, honor the breaker gate, and make the tuner-fed
+        dispatch decision.  Returns the live requests to batch-execute
+        on the vmap path, or ``[]`` when everything already settled
+        (dropped / escalated / grid-routed).  The async front calls this
+        and :meth:`_complete_batch` directly so batch k+1's host staging
+        can overlap batch k's device execution (ISSUE 14)."""
         live = []
         for req in reqs:
             if req.deadline is not None and req.deadline.expired():
@@ -228,20 +274,31 @@ class SolverService:
             else:
                 live.append(req)
         if not live:
-            return
+            return []
         br = self.breaker(bucket)
         if not (self.fastpath and br.allow()):
             _metrics.inc("serve_fastpath_bypass", op=bucket.op)
             for req in live:
                 self._escalate(bucket, req)
-            return
-        tr = active_tracer()
-        span = tr.span(f"serve:batch:{bucket.key()}", n=len(live)) \
-            if tr is not None else _null_cm()
-        with span:
-            xs, seconds = self.executor.run(bucket, live)
+            return []
+        route, prov = self._route(bucket)
+        for req in live:
+            self._dispatch[req.id] = prov
+        if route == "grid":
+            # the tuner's measured grid winner beats the per-request
+            # vmap estimate: serve each request on the distributed path
+            _metrics.inc("serve_grid_dispatch", op=bucket.op)
+            for req in live:
+                self._escalate(bucket, req, path="grid")
+            return []
+        return live
+
+    def _complete_batch(self, bucket: Bucket, live, xs,
+                        seconds: float) -> None:
+        """Post-execution leg: EWMA feedback, trusted certification,
+        breaker bookkeeping, bisect isolation of failures."""
         self.admission.observe_batch(bucket, seconds)
-        tm.tick("batch", bi)
+        br = self.breaker(bucket)
         passed, failed = self._certify(bucket, live, xs)
         if failed:
             br.record_failure()
@@ -252,9 +309,10 @@ class SolverService:
 
     def _certify(self, bucket: Bucket, reqs, xs, path="fastpath"):
         """Trusted per-request residuals; finalize passes, return fails."""
+        meas = ls_residual if bucket.op == "lstsq" else residual
         passed, failed = [], []
         for req, X in zip(reqs, xs):
-            res = residual(req.A, req.B, X)
+            res = meas(req.A, req.B, X)
             if res <= self._tol(req):
                 self._finalize(req, bucket, status="ok", path=path,
                                rung="fastpath", residual=res, x=X)
@@ -292,19 +350,24 @@ class SolverService:
                     self._isolate(bucket, failed, depth + 1)
 
     # ---- escalation --------------------------------------------------
-    def _escalate(self, bucket: Bucket, req, bisected: bool = False) -> None:
+    def _escalate(self, bucket: Bucket, req, bisected: bool = False,
+                  path: str = "escalated") -> None:
         tr = active_tracer()
         span = tr.span(f"serve:req:{req.id}", op=req.op) \
             if tr is not None else _null_cm()
         with span:
-            self._escalate_inner(bucket, req, bisected)
+            self._escalate_inner(bucket, req, bisected, path)
 
-    def _escalate_inner(self, bucket, req, bisected: bool) -> None:
+    def _escalate_inner(self, bucket, req, bisected: bool,
+                        path: str = "escalated") -> None:
         from ..core.dist import MC, MR
         from ..core.distmatrix import from_global
         if req.deadline is not None and req.deadline.expired():
-            self._finalize(req, bucket, status="timed_out", path="escalated",
+            self._finalize(req, bucket, status="timed_out", path=path,
                            timed_out=True, bisected=bisected)
+            return
+        if req.op == "lstsq":
+            self._escalate_lstsq(bucket, req, bisected, path)
             return
         ladder = select_ladder(req.op, self.pressure(),
                                self.degrade_pressure)
@@ -320,12 +383,16 @@ class SolverService:
                                        nb=self.escalate_nb, ladder=ladder,
                                        health=self.health,
                                        deadline=req.deadline)
-            X = None if Xd is None else np.asarray(
+            # owned copy: ``np.asarray`` of a float64 jax CPU array is a
+            # zero-copy view of the device buffer, which the allocator
+            # reuses once the array drops -- a stored solution would
+            # silently mutate under a later solve
+            X = None if Xd is None else np.array(
                 _to_host(Xd), dtype=np.float64)
             _metrics.inc("serve_escalations", op=req.op,
                          rung=str(cert["rung"]))
             if cert["certified"]:
-                self._finalize(req, bucket, status="ok", path="escalated",
+                self._finalize(req, bucket, status="ok", path=path,
                                rung=cert["rung"], residual=cert["residual"],
                                x=X, certificate=cert, retries=retries,
                                bisected=bisected)
@@ -344,10 +411,54 @@ class SolverService:
         timed_out = bool(cert is not None and cert["timed_out"])
         self._finalize(req, bucket,
                        status="timed_out" if timed_out else "failed",
-                       path="escalated", rung=None,
+                       path=path, rung=None,
                        residual=None if cert is None else cert["residual"],
                        x=X, certificate=cert, retries=retries,
                        timed_out=timed_out, bisected=bisected)
+
+    def _escalate_lstsq(self, bucket, req, bisected: bool,
+                        path: str = "escalated") -> None:
+        """Least-squares escalation: the DISTRIBUTED QR path
+        (``lapack.qr.least_squares``) with the same retry/backoff and
+        trusted normal-equations certification as the square ladder
+        (``certified_solve`` has no lstsq rung -- the grid solve IS the
+        stronger rung here)."""
+        from ..core.dist import MC, MR
+        from ..core.distmatrix import from_global, to_global
+        from ..lapack.qr import least_squares
+        tol = self._tol(req)
+        g = self._grid()
+        retries = 0
+        res = None
+        X = None
+        for attempt in range(self.retry.retries + 1):
+            if req.deadline is not None and req.deadline.expired():
+                self._finalize(req, bucket, status="timed_out", path=path,
+                               timed_out=True, bisected=bisected,
+                               retries=retries)
+                return
+            Ad = from_global(req.A, MC, MR, grid=g)
+            Bd = from_global(req.B, MC, MR, grid=g)
+            Xd = least_squares(Ad, Bd, nb=self.escalate_nb)
+            X = np.array(to_global(Xd), dtype=np.float64)  # owned copy
+            res = ls_residual(req.A, req.B, X)
+            _metrics.inc("serve_escalations", op=req.op, rung="grid_qr")
+            if res <= tol:
+                self._finalize(req, bucket, status="ok", path=path,
+                               rung="grid_qr", residual=res, x=X,
+                               retries=retries, bisected=bisected)
+                return
+            if attempt < self.retry.retries:
+                delay = self.retry.delay_s(req.id, attempt + 1, req.deadline)
+                if delay < 0.0:
+                    break
+                if delay > 0.0:
+                    self._sleep(delay)
+                retries += 1
+                _metrics.inc("serve_retries", op=req.op)
+        self._finalize(req, bucket, status="failed", path=path, rung=None,
+                       residual=res, x=X, retries=retries,
+                       bisected=bisected)
 
     # ---- finalize ----------------------------------------------------
     def _finalize(self, req, bucket: Bucket, *, status: str, path: str,
@@ -364,13 +475,22 @@ class SolverService:
                "deadline": req.deadline.to_doc()
                if req.deadline is not None else None,
                "certificate": certificate,
-               "breaker": self.breaker(bucket).state}
+               "breaker": self.breaker(bucket).state,
+               "dispatch": self._dispatch.pop(req.id, None)}
         self.results[req.id] = doc
-        if x is not None and status == "ok":
-            self.solutions[req.id] = x
+        x_out = x if status == "ok" else None
+        if x_out is not None:
+            self.solutions[req.id] = x_out
         _metrics.inc("serve_requests", op=req.op, status=status)
         _metrics.observe("serve_latency_seconds", float(latency),
                          op=req.op)
+        if self.on_result is not None:
+            try:
+                self.on_result(req.id, doc, x_out)
+            except Exception:
+                # a raising completion callback must never poison the
+                # batch-mates still being finalized
+                _metrics.inc("serve_callback_errors", op=req.op)
 
 
 def _to_host(Xd):

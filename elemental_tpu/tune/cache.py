@@ -100,6 +100,22 @@ def make_key(op: str, dims, dtype: str, grid_shape, backend: str) -> CacheKey:
 #: cache directory is unwritable; loads consult it after a file miss
 _MEM_FALLBACK: dict = {}
 
+#: monotone in-process write generation: bumped by every :func:`save` /
+#: :func:`clear` so consumers that MEMOIZE derived state (the serve
+#: executor's tuner-provenance executable keys, ISSUE 14) can detect a
+#: tuner re-sweep cheaply without re-reading cache files on every call
+_EPOCH: int = 0
+
+
+def epoch() -> int:
+    """The in-process tuning-cache write generation (see ``_EPOCH``)."""
+    return _EPOCH
+
+
+def _bump_epoch() -> None:
+    global _EPOCH
+    _EPOCH += 1
+
 #: directories already warned about (warn ONCE per dir per process)
 _WARNED_DIRS: set = set()
 
@@ -122,6 +138,7 @@ def save(key: CacheKey, config: dict, source: str = "measured",
     NEVER raises on an unwritable directory: the entry falls back to the
     in-process memory cache (warn-once + ``write_fallback`` event) so a
     mid-solve measured-winner write cannot take the solve down."""
+    _bump_epoch()
     doc = {"schema": SCHEMA, "op": key.op, "bucket": list(key.bucket),
            "dtype": key.dtype, "grid": list(key.grid_shape),
            "backend": key.backend, "config": dict(config), "source": source,
@@ -346,6 +363,7 @@ def entries() -> list:
 def clear(op: str | None = None) -> int:
     """Delete cache entries (all, or only those of ``op``); returns count.
     In-process fallback entries (unwritable-dir sessions) clear too."""
+    _bump_epoch()
     for name in [n for n in _MEM_FALLBACK
                  if op is None or n.startswith(f"{op}__")]:
         del _MEM_FALLBACK[name]

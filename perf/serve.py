@@ -15,12 +15,20 @@ The command-line face of ``elemental_tpu/serve``:
     python -m perf.serve smoke              # the tools/check.sh gate:
                                             #   mixed-size serving on 1x1
                                             #   AND 2x2 grids, all ok,
-                                            #   exec-cache reuse proven;
-                                            #   exit 1 on any failure
+                                            #   exec-cache reuse proven,
+                                            #   plus the ISSUE-14 lstsq
+                                            #   fast path and the async
+                                            #   pipelined front (streamed
+                                            #   callbacks, no thread
+                                            #   leak); exit 1 on failure
     python -m perf.serve chaos              # the acceptance matrix
                                             #   {bitflip,scale,nan} x
                                             #   {redistribute,compute} x
-                                            #   {oneshot,persistent}:
+                                            #   {oneshot,persistent} plus
+                                            #   the qr op column and the
+                                            #   ISSUE-14 async column
+                                            #   (mid-pipeline isolation +
+                                            #   hard-stop flush):
                                             #   chaos_report/v1 on stdout,
                                             #   exit 1 on any violation
 
@@ -145,6 +153,35 @@ def cmd_smoke() -> int:
                        rng.normal(size=(32, 2)).astype(np.float32))
     print(f"# smoke escalate: status={doc['status']} rung={doc['rung']}")
     if doc["status"] != "ok" or doc["path"] != "escalated":
+        rc = 1
+    # batched QR least-squares executor (ISSUE 14): a tall lstsq must
+    # certify on the fast path against the normal-equations residual
+    svc = SolverService(_grid("1x1"))
+    At = rng.normal(size=(40, 12)).astype(np.float32)
+    Bt = rng.normal(size=(40, 2)).astype(np.float32)
+    X, doc = svc.solve("lstsq", At, Bt)
+    print(f"# smoke lstsq: status={doc['status']} bucket={doc['bucket']}")
+    if doc["status"] != "ok" or doc["path"] != "fastpath":
+        rc = 1
+    # async pipelined front (ISSUE 14): the same mixed workload streams
+    # through AsyncSolverService -- all ok, every completion streamed
+    # via callback, and the worker thread joined (no leak)
+    import threading
+    from elemental_tpu.serve import AsyncSolverService
+    front = AsyncSolverService(grid=_grid("1x1"))
+    streamed: list = []
+    futs = [front.submit(op, A, B,
+                         callback=lambda f: streamed.append(f.id))
+            for op, A, B in _workload(rng, 8, 32)]
+    outs = [f.result(timeout=300.0) for f in futs]
+    ok_async = sum(d["status"] == "ok" for _, d in outs)
+    front.shutdown(drain=True)
+    leak = any(t.name == "elemental-serve-worker" and t.is_alive()
+               for t in threading.enumerate())
+    occ = front.pipeline_stats()["occupancy"]
+    print(f"# smoke async: ok={ok_async}/8 streamed={len(streamed)} "
+          f"leak={leak} occupancy={occ:.2f}")
+    if ok_async != 8 or len(streamed) != 8 or leak:
         rc = 1
     print("# serve smoke:", "ok" if rc == 0 else "FAILED")
     return rc

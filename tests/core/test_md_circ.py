@@ -82,6 +82,59 @@ def test_circ_root_only(any_grid):
     assert np.allclose(np.asarray(el.to_global(C)), F)
 
 
+# ---------------------------------------------------------------------
+# ISSUE 14 satellite: CIRC endpoints folded into the jitted shard_map
+# path (the eager to_global/from_global bridge is gone)
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("dst", [(MC, MR), (VC, STAR), (STAR, STAR)])
+def test_circ_fold_equivalence(any_grid, dst):
+    """Both CIRC legs through the jitted path are bit-identical to the
+    global-bridge reference: gather-to-root stores exactly F on one
+    device; scatter-from-root lands the same local storage as
+    ``from_global`` at the same pair/alignment -- ragged shape included."""
+    F = _f(19, 13)
+    A = el.from_global(F, MC, MR, grid=any_grid)
+    C = el.redistribute(A, CIRC, CIRC)
+    assert len(C.local.devices()) == 1
+    np.testing.assert_array_equal(np.asarray(C.local), F)
+    B = el.redistribute(C, *dst)
+    ref = el.from_global(F, *dst, grid=any_grid)
+    np.testing.assert_array_equal(np.asarray(B.local),
+                                  np.asarray(ref.local))
+
+
+def test_circ_fold_honors_alignment(any_grid):
+    F = _f(11, 9)
+    C = el.from_global(F, CIRC, CIRC, grid=any_grid)
+    B = el.redistribute(C, MC, MR, calign=1, ralign=1)
+    ref = el.from_global(F, MC, MR, grid=any_grid, calign=1, ralign=1)
+    assert (B.calign, B.ralign) == (1, 1)
+    np.testing.assert_array_equal(np.asarray(B.local),
+                                  np.asarray(ref.local))
+
+
+def test_circ_fold_never_calls_eager_bridge(any_grid, monkeypatch):
+    """The fold's whole point: neither CIRC leg may fall back to the
+    eager global bridges (the pre-ISSUE-14 host-sync edge)."""
+    from elemental_tpu.core import distmatrix as dm
+
+    F = _f(9, 7)
+    A = el.from_global(F, MC, MR, grid=any_grid)
+
+    def _boom(*a, **kw):
+        raise AssertionError("CIRC leg escaped to the eager bridge")
+
+    monkeypatch.setattr(dm, "to_global", _boom)
+    monkeypatch.setattr(dm, "from_global", _boom)
+    C = el.redistribute(A, CIRC, CIRC)
+    B = el.redistribute(C, VC, STAR)
+    S = el.redistribute(B, STAR, STAR)
+    monkeypatch.undo()
+    np.testing.assert_array_equal(np.asarray(C.local), F)
+    np.testing.assert_array_equal(np.asarray(S.local), F)
+
+
 def test_get_diagonal_md(any_grid):
     r, c = any_grid.height, any_grid.width
     m = 26

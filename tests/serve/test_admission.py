@@ -78,13 +78,36 @@ def test_admit_promotes_vector_rhs():
 def test_admit_bad_request_structured():
     ctrl = AdmissionController()
     rng = np.random.default_rng(2)
-    rej = ctrl.admit("qr", diag_dom(rng, 8), rng.normal(size=(8, 1)))
+    rej = ctrl.admit("svd", diag_dom(rng, 8), rng.normal(size=(8, 1)))
     assert rej["schema"] == adm.REJECT_SCHEMA
     assert rej["reason"] == "bad_request"
     rej2 = ctrl.admit("lu", rng.normal(size=(8, 4)), rng.normal(size=(8, 1)))
     assert rej2["reason"] == "bad_request"
     rej3 = ctrl.admit("lu", diag_dom(rng, 8), rng.normal(size=(6, 1)))
     assert rej3["reason"] == "bad_request"
+    # lstsq accepts tall A only: a WIDE system is underdetermined
+    rej4 = ctrl.admit("lstsq", rng.normal(size=(5, 12)),
+                      rng.normal(size=(5, 1)))
+    assert rej4["reason"] == "bad_request"
+
+
+def test_admit_lstsq_and_qr_alias(fake_clock):
+    """ISSUE 14: 'qr' aliases lstsq; tall systems bucket with the padded
+    row count M >= m + (N - n) so the identity pad always fits."""
+    ctrl = AdmissionController(clock=fake_clock)
+    rng = np.random.default_rng(4)
+    req = ctrl.admit("qr", rng.normal(size=(12, 5)),
+                     rng.normal(size=(12, 2)))
+    assert req.op == "lstsq"
+    assert req.bucket.key() == "lstsq__b16x8x2__float64"
+    assert (req.bucket.m, req.bucket.n) == (16, 8)
+    assert req.bucket.m >= 12 + (req.bucket.n - 5)
+    # square systems are legal least-squares problems too (m == n)
+    sq = ctrl.admit("lstsq", rng.normal(size=(8, 8)),
+                    rng.normal(size=(8, 1)))
+    assert sq.bucket.key() == "lstsq__b8x8x1__float64"
+    # lstsq flops scale with m (QR of the tall pad), square ops with n^3
+    assert req.bucket.solve_flops() > 0.0
 
 
 def test_admit_expired_deadline_rejects(fake_clock):

@@ -42,6 +42,18 @@ def test_run_bench_schema(grid24, bench_serve):
     # warmup compiled every geometry: the measured window compiles nothing
     assert doc["exec_compiles"] == 0
     assert doc["exec_hits"] >= doc["batches"] >= 1
+    # ISSUE 14: the async pass rides every bench run -- its measured
+    # window reuses the sync warmup's executables (zero compiles), its
+    # payloads are semantically identical, and the worker never leaks
+    for key in ("serve_async_p50_ms", "serve_async_p99_ms",
+                "serve_async_solves_per_sec"):
+        assert isinstance(doc[key], float) and doc[key] > 0
+    assert doc["serve_async_ok"] == 6
+    assert doc["serve_async_exec_compiles"] == 0
+    assert doc["serve_async_payload_identical"] is True
+    assert doc["serve_async_thread_leak"] is False
+    assert doc["serve_async_speedup"] > 0
+    assert doc["serve_pipeline_occupancy"] >= 0.0
 
 
 def _doc(tmp_path, path, **kv):
@@ -56,6 +68,10 @@ def test_bench_diff_gates_serve_metrics(tmp_path, bd):
     assert "serve_p99_ms" in bd.DEFAULT_METRICS
     assert "serve_solves_per_sec" in bd.DEFAULT_METRICS
     assert "serve_p99_ms" in bd.LOWER_IS_BETTER
+    # ISSUE 14: the async pipeline's metrics gate too
+    assert "serve_async_p99_ms" in bd.DEFAULT_METRICS
+    assert "serve_async_solves_per_sec" in bd.DEFAULT_METRICS
+    assert "serve_async_p99_ms" in bd.LOWER_IS_BETTER
     base = _doc(tmp_path, "BENCH_r01.json", serve_p99_ms=10.0,
                 serve_solves_per_sec=100.0)
     # p99 doubled + throughput halved: both regress

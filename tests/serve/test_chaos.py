@@ -95,7 +95,7 @@ def test_full_matrix_report_clean(grid24):
     (ISSUE 11) is what ``perf.serve chaos`` gates in check.sh; tier-1
     covers each qr cell individually below."""
     report = chaos_matrix(grid24, seed=13, service_kw=_CELL_KW,
-                          qr_column=False)
+                          qr_column=False, async_column=False)
     assert report["schema"] == "chaos_report/v1"
     assert len(report["cells"]) == 12
     assert report["ok"] is True
@@ -139,6 +139,89 @@ def test_qr_column_replay_bit_identical(grid24):
     c2, p2 = run_qr_cell(grid24, kind="scale", target="redistribute")
     assert c1 == c2
     assert logs_identical(p1, p2)
+
+
+# ---------------------------------------------------------------------
+# THE ASYNC COLUMN (ISSUE 14) -- faults landing MID-PIPELINE, while the
+# next batch is already dispatched behind the corrupted one.
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("kind", ["bitflip", "scale", "nan"])
+@pytest.mark.parametrize("mode", ["oneshot", "persistent"])
+def test_async_column_cell(grid24, kind, mode):
+    """Every async cell runs two pipelined batches, fires, and violates
+    nothing: zero silent garbage, zero silent drops, every failure
+    structured."""
+    from elemental_tpu.serve import run_async_cell
+    cell, plan, front = run_async_cell(grid24, kind=kind, mode=mode,
+                                       service_kw=_CELL_KW)
+    assert cell["fired"] > 0, "fault never landed: the cell is vacuous"
+    assert cell["violations"] == []
+    assert cell["column"] == "async" and cell["batches"] == 2
+    assert cell["verdict"] in ("absorbed", "isolated", "surfaced")
+
+
+def test_async_oneshot_spares_neighbor_batch(grid24):
+    """A one-shot NaN on batch 0's compute seam: batch 1 was ALREADY
+    dispatched behind it (double buffering) when the corruption landed
+    -- and every batch-1 request still certifies ok.  Mid-pipeline
+    faults stay isolated to their own batch."""
+    from elemental_tpu.serve import run_async_cell
+    cell, plan, front = run_async_cell(grid24, kind="nan", mode="oneshot",
+                                       requests=8, nelem=4,
+                                       service_kw=_CELL_KW)
+    assert cell["violations"] == []
+    # the fault hit batch 0's 4-slot dispatch, not the 8-request set
+    assert plan.log[0].target == "compute"
+    assert plan.log[0].shape == (4, 16, 2)
+    # batch-1 requests (ids 4..7 -- FIFO ingest fixes membership) all ok
+    results = front.service.results
+    for rid in range(4, 8):
+        assert results[rid]["status"] == "ok", f"neighbor {rid} poisoned"
+
+
+def test_async_cell_replay_bit_identical(grid24):
+    """Pre-loaded submission queue + single worker: the async cell is
+    deterministic -- same outcomes, same verdict, bit-identical fault
+    logs across runs."""
+    from elemental_tpu.resilience import logs_identical as _li
+    from elemental_tpu.serve import run_async_cell
+    c1, p1, _ = run_async_cell(grid24, kind="scale", mode="oneshot",
+                               service_kw=_CELL_KW)
+    c2, p2, _ = run_async_cell(grid24, kind="scale", mode="oneshot",
+                               service_kw=_CELL_KW)
+    assert c1 == c2
+    assert _li(p1, p2)
+
+
+def test_async_shutdown_under_load_cell(grid24):
+    """The hard-stop cell: batches 0 and 1 complete ok, batch 2 flushes
+    with structured shutdown rejects, zero silent drops, post-shutdown
+    submits reject -- deterministic via the parked-worker gate."""
+    from elemental_tpu.serve import run_async_shutdown_cell
+    cell, front = run_async_shutdown_cell(grid24, requests=12,
+                                          service_kw=_CELL_KW)
+    assert cell["violations"] == []
+    assert cell["verdict"] == "isolated"
+    assert cell["ok"] == 8 and cell["flushed"] == 4
+    assert cell["column"] == "async" and cell["mode"] == "drain_false"
+
+
+@pytest.mark.slow
+def test_full_matrix_with_async_column(grid24):
+    """The 19-cell report chaos gates in check.sh: 12 sync cells + 6
+    async fault cells + the shutdown cell (qr column covered per-cell
+    above).  Slow-marked: every cell above runs individually in tier-1;
+    the aggregate is what ``perf.serve chaos`` gates."""
+    report = chaos_matrix(grid24, seed=13, service_kw=_CELL_KW,
+                          qr_column=False, async_column=True)
+    assert len(report["cells"]) == 19
+    assert report["ok"] is True
+    assert report["violations_total"] == 0
+    async_cells = [c for c in report["cells"]
+                   if c.get("column") == "async"]
+    assert len(async_cells) == 7
+    assert sum(c["kind"] == "shutdown" for c in async_cells) == 1
 
 
 # ---------------------------------------------------------------------

@@ -111,6 +111,177 @@ def test_residual_semantics():
     assert residual(A, B, X + 1.0) > 1e-3
 
 
+# ---------------------------------------------------------------------
+# ISSUE 14: batched QR least-squares + donation + tuner-provenance keys
+# ---------------------------------------------------------------------
+
+def test_pad_problem_ls_lossless():
+    """The lstsq pad puts an identity in the EXTRA rows x EXTRA columns:
+    pad columns are orthogonal to A's, the padded normal equations
+    decouple, and the padded minimizer's head IS the original LS
+    minimizer (tail exactly zero)."""
+    from elemental_tpu.serve import pad_problem_ls
+    rng = np.random.default_rng(15)
+    A = rng.normal(size=(13, 5))
+    B = rng.normal(size=(13, 2))
+    bucket = make_bucket("lstsq", 5, 2, A.dtype, m=13)
+    assert (bucket.m, bucket.n, bucket.nrhs) == (16, 8, 2)
+    Ap, Bp = pad_problem_ls(A, B, bucket)
+    assert Ap.shape == (16, 8) and Bp.shape == (16, 2)
+    np.testing.assert_array_equal(Ap[:13, :5], A)
+    np.testing.assert_array_equal(Ap[13:16, 5:8], np.eye(3))
+    assert not Ap[:13, 5:].any() and not Ap[13:, :5].any()
+    assert not Bp[13:].any()
+    Xp = np.linalg.lstsq(Ap, Bp, rcond=None)[0]
+    np.testing.assert_allclose(Xp[:5], np.linalg.lstsq(A, B, rcond=None)[0],
+                               rtol=1e-9, atol=1e-11)
+    np.testing.assert_allclose(Xp[5:], 0, atol=1e-11)
+
+
+def test_run_batch_lstsq_matches_numpy():
+    """Mixed-actual-shape tall systems of one lstsq bucket solve to the
+    LS minimizer in ONE batched QR dispatch."""
+    from elemental_tpu.serve import ls_residual
+    rng = np.random.default_rng(16)
+    ctrl = AdmissionController()
+    probs = [(rng.normal(size=(m, n)), rng.normal(size=(m, 2)))
+             for m, n in ((12, 5), (16, 8), (10, 7), (14, 8))]
+    reqs = _reqs(ctrl, "lstsq", probs)
+    assert len({r.bucket for r in reqs}) == 1        # one bucket: 16x8x2
+    ex = Executor()
+    xs, seconds = ex.run(reqs[0].bucket, reqs)
+    assert seconds >= 0.0
+    for (A, B), X in zip(probs, xs):
+        assert X.shape == (A.shape[1], 2)
+        np.testing.assert_allclose(X, np.linalg.lstsq(A, B, rcond=None)[0],
+                                   rtol=1e-7, atol=1e-9)
+        assert ls_residual(A, B, X) < 1e-12
+
+
+def test_ls_residual_semantics():
+    from elemental_tpu.serve import ls_residual
+    rng = np.random.default_rng(17)
+    A = rng.normal(size=(20, 6))
+    B = rng.normal(size=(20, 2))
+    X = np.linalg.lstsq(A, B, rcond=None)[0]
+    # vanishes at the minimizer even though B - A X cannot
+    assert ls_residual(A, B, X) < 1e-14
+    assert np.linalg.norm(B - A @ X) > 1e-3
+    assert ls_residual(A, B, X + 1.0) > 1e-3
+    assert ls_residual(A, B, np.full_like(X, np.nan)) == float("inf")
+
+
+def test_exec_cache_key_tune_and_donate_variants():
+    """Default keys are byte-identical to PR 9; a tuner-provenance token
+    and the donation flag each append their own suffix (distinct cache
+    entries, never a stale or non-donating executable)."""
+    from elemental_tpu.serve.executor import ExecutableCache
+    b = make_bucket("hpd", 100, 2, np.float32)
+    base = ExecutableCache.key("hpd", b, 8, "cpu")
+    assert base == "hpd__b128x2__x8__float32__cpu"
+    assert ExecutableCache.key("hpd", b, 8, "cpu", tune="0a1b2c3d") \
+        == base + "__t0a1b2c3d"
+    assert ExecutableCache.key("hpd", b, 8, "cpu", donate=True) \
+        == base + "__donated"
+    assert ExecutableCache.key("hpd", b, 8, "cpu", tune="0a1b2c3d",
+                               donate=True) == base + "__t0a1b2c3d__donated"
+    # lstsq buckets carry the padded row count in the geometry
+    bl = make_bucket("lstsq", 5, 2, np.float32, m=13)
+    assert ExecutableCache.key("lstsq", bl, 4, "cpu") \
+        == "lstsq__b16x8x2__x4__float32__cpu"
+
+
+def test_donated_executable_distinct_entry_same_bits():
+    """donate=True compiles its own __donated executable; the solutions
+    are bit-identical to the non-donating path."""
+    rng = np.random.default_rng(18)
+    ctrl = AdmissionController()
+    probs = [(diag_dom(rng, 12), rng.normal(size=(12, 2)))
+             for _ in range(3)]
+    reqs = _reqs(ctrl, "lu", probs)
+    b = reqs[0].bucket
+    ex = Executor()
+    xs0, _ = ex.run(b, reqs)
+    xs1, _ = ex.run(b, reqs, donate=True)
+    entries = ex.cache.stats()["entries"]
+    assert len(entries) == 2
+    assert sum(k.endswith("__donated") for k in entries) == 1
+    for a, c in zip(xs0, xs1):
+        np.testing.assert_array_equal(a, c)
+
+
+def test_tune_token_resweep_invalidates_executable(tmp_path, monkeypatch):
+    """SATELLITE: executable keys carry the resolved tuner provenance --
+    a tuning-cache re-sweep (save bumps the in-process epoch) changes the
+    token, so the next batch compiles FRESH instead of serving the stale
+    executable; a second re-sweep re-keys again."""
+    import jax
+    from elemental_tpu.serve.executor import tune_token
+    from elemental_tpu.tune import cache as tc
+    monkeypatch.setenv(tc.ENV_DIR, str(tmp_path))
+    backend = jax.default_backend()
+    rng = np.random.default_rng(19)
+    ctrl = AdmissionController()
+    reqs = _reqs(ctrl, "hpd", [(spd(rng, 12), rng.normal(size=(12, 1)))])
+    b = reqs[0].bucket                               # hpd 16x1 float64
+    assert tune_token("hpd", b, backend) == ""       # cold cache: PR-9 key
+    ex = Executor()
+    with _metrics.scoped() as reg:
+        def compiles():
+            return sum(v for (nm, lb), v in
+                       reg.counters("serve_exec_cache_events").items()
+                       if dict(lb).get("event") == "compile")
+
+        ex.run(b, reqs)
+        ex.run(b, reqs)
+        assert compiles() == 1                       # warm: hit
+        key = tc.make_key("cholesky", (16, 16), b.dtype, (1, 1), backend)
+        tc.save(key, {"nb": 8}, source="measured",
+                metric={"seconds": 1e-3})            # tuner re-sweep
+        tok = tune_token("hpd", b, backend)
+        assert tok != ""
+        ex.run(b, reqs)
+        assert compiles() == 2                       # stale binary retired
+        assert any(f"__t{tok}" in k for k in ex.cache.stats()["entries"])
+        tc.save(key, {"nb": 4}, source="measured",
+                metric={"seconds": 2e-3})            # different winner
+        tok2 = tune_token("hpd", b, backend)
+        assert tok2 not in ("", tok)
+        ex.run(b, reqs)
+        assert compiles() == 3
+
+
+def test_route_for_tuner_fed_dispatch(tmp_path, monkeypatch):
+    """SATELLITE: dispatch consults the tuning cache -- only a MEASURED
+    winner whose seconds beat the vmap estimate flips the route to the
+    grid path, and the provenance doc records the decision inputs."""
+    import jax
+    from elemental_tpu.serve import route_for
+    from elemental_tpu.tune import cache as tc
+    monkeypatch.setenv(tc.ENV_DIR, str(tmp_path))
+    backend = jax.default_backend()
+    b = make_bucket("hpd", 12, 1, np.float64)
+    key = tc.make_key("cholesky", (16, 16), b.dtype, (2, 2), backend)
+
+    route, prov = route_for(b, (2, 2), backend, est_vmap_s=1e-3)
+    assert (route, prov["source"], prov["tune_token"]) \
+        == ("vmap", "default", "")
+    assert prov["driver_op"] == "cholesky" and prov["grid"] == [2, 2]
+    # a measured winner SLOWER than the vmap estimate stays on vmap
+    tc.save(key, {"nb": 8}, source="measured", metric={"seconds": 5e-3})
+    route, prov = route_for(b, (2, 2), backend, est_vmap_s=1e-3)
+    assert route == "vmap" and prov["source"] == "measured"
+    assert prov["measured_s"] == pytest.approx(5e-3)
+    # a faster measured winner flips the bucket to the grid path
+    tc.save(key, {"nb": 8}, source="measured", metric={"seconds": 1e-6})
+    route, prov = route_for(b, (2, 2), backend, est_vmap_s=1e-3)
+    assert route == "grid" and prov["route"] == "grid"
+    # non-measured winners never flip the route, however fast
+    tc.save(key, {"nb": 8}, source="manual", metric={"seconds": 1e-9})
+    route, _ = route_for(b, (2, 2), backend, est_vmap_s=1e-3)
+    assert route == "vmap"
+
+
 def test_compute_fault_seam_on_batch_output():
     """The executor's batch output crosses the 'compute' fault target:
     corruption lands in the returned solutions, is logged with the batch

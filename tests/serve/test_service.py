@@ -49,10 +49,16 @@ def test_result_schema_pin(grid24):
     assert set(doc) == {"schema", "id", "op", "n", "nrhs", "bucket",
                         "status", "path", "rung", "residual", "tol",
                         "retries", "bisected", "timed_out", "latency_s",
-                        "deadline", "certificate", "breaker"}
+                        "deadline", "certificate", "breaker", "dispatch"}
     assert doc["bucket"] == "lu__b8x1__float64"
     assert doc["deadline"] is None and doc["certificate"] is None
     assert doc["breaker"] == "closed"
+    # tuner-fed dispatch provenance (ISSUE 14): fastpath requests carry
+    # the resolved route; a cold tuning cache routes vmap with an empty
+    # tune token
+    disp = doc["dispatch"]
+    assert disp is not None and disp["route"] in ("vmap", "grid")
+    assert {"route", "driver_op", "tune_token", "source"} <= set(disp)
     assert X is not None
 
 
@@ -192,6 +198,52 @@ def test_fifo_across_buckets(grid24, fake_clock):
     # the lu request waited longer than the hpd one
     assert done[a]["latency_s"] > done[b]["latency_s"]
     assert done[a]["status"] == done[b]["status"] == "ok"
+
+
+# ---------------------------------------------------------------------
+# ISSUE 14: tuner-fed dispatch + the lstsq serving path
+# ---------------------------------------------------------------------
+
+def test_measured_winner_routes_bucket_to_grid(grid24, tmp_path,
+                                               monkeypatch):
+    """A MEASURED tuning-cache winner that beats the vmap estimate pulls
+    the request off the batch path onto the distributed driver, and the
+    decision lands in serve_result/v1 provenance."""
+    import jax
+    from elemental_tpu.tune import cache as tc
+    monkeypatch.setenv(tc.ENV_DIR, str(tmp_path))
+    rng = np.random.default_rng(31)
+    svc = SolverService(grid24)
+    key = tc.make_key("cholesky", (16, 16), "float64",
+                      (grid24.height, grid24.width), jax.default_backend())
+    tc.save(key, {"nb": 8}, source="measured", metric={"seconds": 1e-12})
+    X, doc = svc.solve("hpd", spd(rng, 16), rng.normal(size=(16, 2)))
+    assert doc["status"] == "ok"
+    assert doc["path"] == "grid"
+    disp = doc["dispatch"]
+    assert disp["route"] == "grid" and disp["source"] == "measured"
+    assert disp["measured_s"] == pytest.approx(1e-12)
+    assert X is not None and doc["residual"] <= doc["tol"]
+
+
+def test_lstsq_fastpath_and_grid_qr_escalation(grid24):
+    """Tall least-squares requests serve through the batched QR fast
+    path; with the fastpath off they escalate to the distributed QR
+    rung ('grid_qr') -- both certify on the normal-equations residual."""
+    rng = np.random.default_rng(32)
+    A = rng.normal(size=(24, 10))
+    B = rng.normal(size=(24, 2))
+    Xref = np.linalg.lstsq(A, B, rcond=None)[0]
+    svc = SolverService(grid24)
+    X, doc = svc.solve("lstsq", A, B)
+    assert doc["status"] == "ok" and doc["path"] == "fastpath"
+    assert doc["bucket"].startswith("lstsq__b")
+    np.testing.assert_allclose(X, Xref, rtol=1e-8, atol=1e-10)
+    svc2 = SolverService(grid24, fastpath=False)
+    X2, doc2 = svc2.solve("qr", A, B)            # 'qr' aliases lstsq
+    assert doc2["status"] == "ok" and doc2["path"] == "escalated"
+    assert doc2["rung"] == "grid_qr"
+    np.testing.assert_allclose(X2, Xref, rtol=1e-6, atol=1e-8)
 
 
 # ---------------------------------------------------------------------
