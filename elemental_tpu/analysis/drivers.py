@@ -214,13 +214,17 @@ def _lu_spec(variant, lookahead, crossover, panel="classic",
                       allow_bf16=comm_precision is not None)
 
 
-def _qr_spec(variant="", panel="classic"):
+def _qr_spec(variant="", panel="classic", abft=False):
     def build(grid, n, nb, dtype):
         from ..lapack.qr import qr
 
         def fn(a):
-            return qr(_as_dm(a, grid, n, n), nb=nb, panel=panel)
-        return fn, (_mcmr_input(grid, n, n, dtype),), {"panel": panel}
+            return qr(_as_dm(a, grid, n, n), nb=nb, panel=panel,
+                      abft=abft or None)
+        # the abft key is CONDITIONAL so the pre-ISSUE-15 qr / qr_tsqr
+        # golden docs stay byte-identical (to_doc merges meta verbatim)
+        meta = {"panel": panel, **({"abft": True} if abft else {})}
+        return fn, (_mcmr_input(grid, n, n, dtype),), meta
     return DriverSpec(f"qr_{variant}" if variant else "qr", build)
 
 
@@ -262,6 +266,11 @@ def _registry() -> dict:
         # so checksum overhead changes are a reviewed diff
         _lu_spec("abft", lookahead=False, crossover=0, abft=True),
         _cholesky_spec("abft", lookahead=False, crossover=0, abft=True),
+        # qr_abft = ISSUE 15's guarded QR: the same blocked Householder
+        # schedule plus the checksum reductions (panel gathers unchanged,
+        # one extra [MC,MR] panel write already shared with the plain
+        # sweep) -- pins the guarded collective structure like lu_abft
+        _qr_spec("abft", abft=True),
         # direct = ISSUE 12's one-shot redistribution twins: the SAME
         # schedule knobs as the baseline variant plus redist_path=
         # 'direct', so the golden pair pins the plan-compiler win exactly

@@ -195,7 +195,8 @@ def _panel_qr_tsqr(P, r: int, precision=None):
 
 def qr(A: DistMatrix, nb: int | str | None = None, precision=None,
        panel: str = "classic", comm_precision: str | None = None,
-       timer=None, health=None, redist_path: str | None = None):
+       timer=None, health=None, redist_path: str | None = None,
+       abft=None):
     """Blocked Householder QR; returns (packed, tau) in geqrf format.
 
     ``nb='auto'`` asks the tuning subsystem for the panel width.  The
@@ -238,7 +239,17 @@ def qr(A: DistMatrix, nb: int | str | None = None, precision=None,
     -- which carries R's diagonal (the larfg betas) -- is checked for
     near-zero entries, the QR image of rank deficiency.  ``health=None``
     (default) attaches nothing: the zero-overhead NULL_HOOK path, pinned
-    by redist-count equality and the unchanged qr/qr_tsqr comm goldens."""
+    by redist-count equality and the unchanged qr/qr_tsqr comm goldens.
+
+    ``abft`` opts into Huang-Abraham checksum guarding with per-panel
+    transactional recovery (ISSUE 15; same contract as
+    ``lu``/``cholesky``): pass ``True`` (report retrievable via
+    ``resilience.last_abft_report('qr')``) or a caller-owned
+    ``AbftGuard``.  The guarded schedule keeps ``panel=`` ('classic' and
+    'tsqr' are both guarded) but ignores ``redist_path`` -- per-panel
+    transactions pin the default hop-chain gathers.  ``abft=None``
+    (default) never imports the resilience module: the unguarded sweep
+    is bit-identical and its comm goldens unchanged."""
     _check_mcmr(A)
     m, n = A.gshape
     g = A.grid
@@ -259,6 +270,11 @@ def qr(A: DistMatrix, nb: int | str | None = None, precision=None,
     if panel not in ("classic", "tsqr"):
         raise ValueError(f"qr: unknown panel strategy {panel!r}; "
                          "expected 'classic', 'tsqr', or 'auto'")
+    if abft:
+        from ..resilience.abft import abft_qr
+        return abft_qr(A, nb=nb, precision=precision, panel=panel,
+                       comm_precision=comm_precision, timer=timer,
+                       health=health, abft=abft)
     tm = _phase_hook("qr", timer)
     hm = None
     if health:
@@ -385,19 +401,24 @@ def explicit_q(Ap: DistMatrix, tau, nb: int | None = None,
 
 
 def least_squares(A: DistMatrix, B: DistMatrix, nb: int | None = None,
-                  precision=None) -> DistMatrix:
+                  precision=None, abft=None) -> DistMatrix:
     """Minimize ||A X - B||_F for m >= n via QR (``El::LeastSquares``,
     dense path of ``src/lapack_like/euclidean_min/LeastSquares.cpp``).
 
     Fully distributed: Q^H B via packed reflectors, then a distributed
-    triangular solve against the interior-extracted R (no replication)."""
+    triangular solve against the interior-extracted R (no replication).
+
+    ``abft`` threads through to :func:`qr` (ISSUE 15): the factorization
+    -- the solve's whole O(m n^2) fault surface -- runs checksum-guarded
+    with panel-granular recovery, so the serve executor's ``grid_qr``
+    escalation rung is corruption-attested end to end."""
     from ..redist.interior import interior_view      # qr <- interior is cycle-free
     from ..blas.level1 import make_trapezoidal
     _check_mcmr(A, B)
     m, n = A.gshape
     if m < n:
         raise ValueError("least_squares requires m >= n (tall)")
-    Ap, tau = qr(A, nb=nb, precision=_hi(precision))
+    Ap, tau = qr(A, nb=nb, precision=_hi(precision), abft=abft)
     Y = apply_q(Ap, tau, B, orient="C", nb=nb, precision=_hi(precision))
     R = make_trapezoidal(interior_view(Ap, (0, n), (0, n)), "U")
     Y1 = interior_view(Y, (0, n), (0, B.gshape[1]))

@@ -5,7 +5,9 @@ The numeric half of the observability subsystem (the span half is
 
   * counters   -- monotonically increasing totals (driver invocation
                   counts, redistribute calls/bytes, tuning-cache
-                  hit/miss/stale events);
+                  hit/miss/stale events, and the ``abft_checks`` /
+                  ``abft_violations`` / ``abft_recovered_panels``
+                  family labelled by ``driver`` in {lu, cholesky, qr});
   * gauges     -- last-written values;
   * histograms -- summary stats + a fixed log-ladder bucket table
                   (phase wall-clock observations).

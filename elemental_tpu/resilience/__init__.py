@@ -19,10 +19,10 @@ makes numerical corruption DETECTED, REPORTED, and RECOVERED:
                    target (ISSUE 9) -- of local panel/batch kernel
                    outputs -- the test harness proving every corruption
                    class is repaired or surfaced
-  :mod:`.abft`     checksum-guarded factorizations (ISSUE 11):
-                   ``lu(..., abft=)`` / ``cholesky(..., abft=)`` verify
-                   Huang-Abraham column-sum invariants per panel ->
-                   ``abft_report/v1``
+  :mod:`.abft`     checksum-guarded factorizations (ISSUE 11 + 15):
+                   ``lu(..., abft=)`` / ``cholesky(..., abft=)`` /
+                   ``qr(..., abft=)`` verify Huang-Abraham column-sum
+                   invariants per panel -> ``abft_report/v1``
   :mod:`.recovery` the panel-transaction layer: a violated panel step is
                    rolled back and re-executed (bounded retries), so a
                    transient fault costs ONE recomputed panel instead of
@@ -39,7 +39,7 @@ from .certify import (CERT_SCHEMA, LADDER_NAMES, Rung, certified_solve,
 from .faults import (FAULT_KINDS, FAULT_TARGETS, FaultEvent, FaultPlan,
                      FaultSpec, logs_identical)
 from .abft import (ABFT_SCHEMA, AbftGuard, abft_cholesky, abft_lu,
-                   last_abft_report)
+                   abft_qr, last_abft_report)
 from .recovery import run_step
 
 __all__ = [
@@ -49,6 +49,6 @@ __all__ = [
     "default_ladder", "default_tol",
     "FAULT_KINDS", "FAULT_TARGETS", "FaultEvent", "FaultPlan", "FaultSpec",
     "logs_identical", "fault_injection",
-    "ABFT_SCHEMA", "AbftGuard", "abft_cholesky", "abft_lu",
+    "ABFT_SCHEMA", "AbftGuard", "abft_cholesky", "abft_lu", "abft_qr",
     "last_abft_report", "run_step",
 ]

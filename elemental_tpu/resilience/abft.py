@@ -1,6 +1,6 @@
-"""ABFT checksum-guarded factorizations (Huang-Abraham, ISSUE 11).
+"""ABFT checksum-guarded factorizations (Huang-Abraham, ISSUE 11 + 15).
 
-Algorithm-based fault tolerance for the distributed LU / Cholesky
+Algorithm-based fault tolerance for the distributed LU / Cholesky / QR
 drivers: every panel step maintains PER-COLUMN checksum vectors through
 the same redistribute / ``panel_spread`` / trailing-matmul path the
 unguarded schedule uses, and verifies the checksum invariants with one
@@ -22,12 +22,23 @@ any two distributions compare elementwise):
     unit-lower/upper split must reproduce the gathered panel's sums.
   * **factor (Cholesky)** -- ``colsum(L11 L11^H) == colsum(L11) @
     L11^H`` against the symmetrized diagonal block.
+  * **factor (QR)** -- ``c(A) = c(Q R)``: the packed panel is the
+    compact-WY image ``(I - V T V^H) [R; 0]`` of the gathered columns,
+    so ``colsum(panel) == colsum(R) - cV @ (T @ (V1^H R))`` with
+    ``cV = 1^T V`` -- valid for BOTH the classic larfg recurrence and
+    the TSQR tree (the tree preserves column sums leaf-to-root, so one
+    check at reconstruction covers it; the packed ``(V, tau, R)`` is
+    self-consistent whichever panel produced it).
   * **solve** -- ``colsum(L11 @ U12) == colsum(A12)`` (LU row-block
     solve) / ``colsum(L21 L11^H) == colsum(A21)`` (Cholesky panel).
   * **trailing update (Huang-Abraham)** -- ``colsum(A22') ==
     colsum(A22) - colsum(L21) @ U12``, with ``colsum(L21)`` taken from
     the REPLICATED packed panel so the prediction is independent of the
-    transported operands the update itself consumed.  (Cholesky's
+    transported operands the update itself consumed.  QR's compact-WY
+    form obeys the same separable identity: ``1^T (V_mc W) == cV @ W``
+    with ``W = T^H (V^H A2)``, so the trailing colsums are pinned by
+    ``c(A2) - cV @ W`` with ``cV`` again from the replicated panel,
+    independent of the transported ``V_mc``.  (Cholesky's
     masked-lower update has no separable column identity; its trailing
     check is consistency-grade -- the predicted delta is reduced from
     the update product itself -- while its fault surface is covered by
@@ -54,12 +65,14 @@ pin the guarded schedule), but comparison/rollback happen host-side and
 degrade to pass-through under jit -- one attempt per panel, static
 control flow.
 
-``lu(..., abft=True)`` / ``cholesky(..., abft=True)`` dispatch here
-(``abft=`` also accepts a caller-owned :class:`AbftGuard`); ``abft=None``
-never imports this module -- the unguarded drivers are bit-identical to
-before and their comm goldens unchanged.  The guarded schedule is the
-CLASSIC right-looking one on every grid (lookahead / crossover / calu
-do not compose with per-panel transactions and are ignored), including
+``lu(..., abft=True)`` / ``cholesky(..., abft=True)`` /
+``qr(..., abft=True)`` dispatch here (``abft=`` also accepts a
+caller-owned :class:`AbftGuard`); ``abft=None`` never imports this
+module -- the unguarded drivers are bit-identical to before and their
+comm goldens unchanged.  The guarded schedule is the CLASSIC
+right-looking one on every grid (lookahead / crossover / calu do not
+compose with per-panel transactions and are ignored; qr keeps its
+``panel=`` choice -- both 'classic' and 'tsqr' are guarded), including
 1x1 -- so fault seams and comm plans are grid-uniform.
 """
 from __future__ import annotations
@@ -631,3 +644,138 @@ def abft_cholesky(A, nb=None, precision=None, comm_precision=None,
     if hm is not None:
         hm.report()
     return make_trapezoidal(L, "L")
+
+
+# ---------------------------------------------------------------------
+# guarded QR (blocked Householder schedule + per-panel transactions)
+# ---------------------------------------------------------------------
+
+def abft_qr(A, nb=None, precision=None, panel="classic",
+            comm_precision=None, timer=None, health=None, abft=True):
+    """Checksum-guarded blocked Householder QR (see module docstring).
+
+    Same ``(packed, tau)`` geqrf contract as ``lapack.qr``; reached via
+    ``qr(..., abft=)``.  ``panel`` keeps its 'classic'/'tsqr' meaning
+    (the factor invariant only consumes the self-consistent packed
+    ``(V, tau, R)``, so the TSQR tree is guarded by the same single
+    reconstruction check); the panel gathers ride the default hop-chain
+    path (``redist_path`` does not compose with per-panel transactions
+    and is ignored)."""
+    import jax.numpy as jnp
+    from ..core.dist import MC, MR, STAR
+    from ..core.distmatrix import DistMatrix
+    from ..core.view import view
+    from ..redist.engine import apply_fault, redistribute
+    from ..blas.level3 import _blocksize
+    from ..lapack.lu import (_hi, _phase_hook, _update_cols_ge,
+                             _update_cols_lt)
+    from ..lapack.qr import (_larft, _panel_qr, _panel_qr_tsqr, _panel_v,
+                             _record_qr_nb)
+    from .recovery import run_step
+    from .health import attach_health
+
+    guard = resolve_abft(abft)
+    m, n = A.gshape
+    g = A.grid
+    guard.begin("qr", A, comm_precision=comm_precision)
+    tm = _phase_hook("qr", timer)
+    hm = None
+    if health:
+        tm, hm = attach_health("qr", health, tm, scale_from=A)
+    r, c = g.height, g.width
+    ib = _blocksize(nb, math.lcm(r, c), min(m, n))
+    kend = min(m, n)
+    cp = comm_precision
+    tm.start()
+
+    def step_fn(A, k, s):
+        # ticks buffered per attempt, replayed on commit (see abft_lu)
+        ticks = []
+        e = min(s + ib, kend)
+        nbw = e - s
+        e_up = min(-(-e // c) * c, n)
+        pan_v = view(A, rows=(s, m), cols=(s, e_up))
+        pan_sum = _colsum(pan_v)
+        pan_mass = _colsum(pan_v, absval=True)
+        panel_ss = redistribute(pan_v, STAR, STAR, comm_precision=cp)
+        ploc = panel_ss.local[:m - s, :e_up - s]
+        guard.check("panel_gather", pan_sum, jnp.sum(ploc, axis=0),
+                    mass=pan_mass, kind="transport", rows=m - s)
+        if panel == "tsqr":
+            Pf, tau = _panel_qr_tsqr(ploc[:, :nbw], r, precision)
+        else:
+            Pf, tau = _panel_qr(ploc[:, :nbw])
+        Pf, = apply_fault("compute", (Pf,))
+        # factor invariant: panel = (I - V T V^H) [R; 0], so
+        # colsum(panel) == colsum(R) - cV @ (T @ (V1^H R))
+        V = _panel_v(Pf)
+        T = _larft(V, tau)
+        R11 = jnp.triu(Pf[:nbw])
+        cV = jnp.sum(V, axis=0)
+        rpred = (jnp.sum(R11, axis=0)
+                 - jnp.matmul(cV, jnp.matmul(
+                     T, jnp.matmul(jnp.conj(V[:nbw]).T, R11))))
+        guard.check("panel", rpred, jnp.sum(ploc[:, :nbw], axis=0),
+                    mass=jnp.sum(jnp.abs(ploc[:, :nbw]), axis=0),
+                    kind="compute", rows=m - s, nb=nbw)
+        if e_up > e:
+            Pf_w = jnp.pad(Pf, ((0, 0), (0, e_up - e)))
+        else:
+            Pf_w = Pf
+        Pf_ss = DistMatrix(Pf_w, (m - s, e_up - s), STAR, STAR, 0, 0, g)
+        pf_w = redistribute(Pf_ss, MC, MR)
+        guard.check("panel_write", jnp.sum(Pf_w, axis=0), _colsum(pf_w),
+                    mass=jnp.sum(jnp.abs(Pf_w), axis=0),
+                    kind="transport", rows=m - s)
+        A = _update_cols_lt(A, pf_w, (s, m), (s, e_up), e)
+        if e < n:
+            V_ss = DistMatrix(V, (m - s, nbw), STAR, STAR, 0, 0, g)
+            V_mc = redistribute(V_ss, MC, STAR)
+            guard.check("v_move", cV, _colsum(V_mc),
+                        mass=jnp.sum(jnp.abs(V), axis=0),
+                        kind="transport", rows=m - s)
+            A2 = view(A, rows=(s, m), cols=(s, n))
+            t_pre = _colsum(A2)
+            t_mass = _colsum(A2, absval=True)
+            W = jnp.matmul(jnp.conj(V_mc.local).T, A2.local,
+                           precision=_hi(precision))
+            W = jnp.matmul(jnp.conj(T).T, W, precision=_hi(precision))
+            upd = jnp.matmul(V_mc.local, W, precision=_hi(precision))
+            # Huang-Abraham: 1^T (V_mc W) == cV @ W, cV from the
+            # REPLICATED panel -- independent of the transported V_mc.
+            # The strip's first nbw global columns hold the already-
+            # written packed panel; _update_cols_ge leaves them
+            # untouched, so their predicted delta is exactly zero.
+            _, J = _indices(A2)
+            delta = _scatter_cols(jnp.matmul(cV, W), J, n - s)
+            dmass = _scatter_cols(
+                jnp.matmul(jnp.abs(cV), jnp.abs(W)), J, n - s)
+            keep = jnp.arange(n - s) >= nbw
+            delta = jnp.where(keep, delta, 0)
+            dmass = jnp.where(keep, dmass, 0)
+            A = _update_cols_ge(
+                A, A2.with_local(A2.local - upd.astype(A.dtype)),
+                (s, m), (s, n), e)
+            guard.check("update", t_pre - delta,
+                        _colsum(view(A, rows=(s, m), cols=(s, n))),
+                        mass=t_mass + dmass, kind="compute",
+                        rows=m - s, nb=nbw)
+            ticks.append(("update", (A,)))
+        return A, Pf, tau, ticks
+
+    taus = []
+    for k, s in enumerate(range(0, kend, ib)):
+        # taus accumulate in the COMMIT loop, never inside the
+        # transaction body: a retried attempt must not double-append
+        A, Pf, tau, ticks = run_step(
+            guard, k, lambda st: step_fn(st, k, s), A)
+        taus.append(tau)
+        tm.tick("panel", k, Pf, tau)
+        for phase, arrs in ticks:
+            tm.tick(phase, k, *arrs)
+    _record_qr_nb(A, ib)
+    guard.flag_health(hm)
+    guard.report()
+    if hm is not None:
+        hm.report()
+    return A, jnp.concatenate(taus) if taus else jnp.zeros((0,), A.dtype)

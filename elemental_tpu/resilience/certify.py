@@ -89,7 +89,10 @@ TOL_FACTOR = 64.0
 #: 'abft' (ISSUE 11) sits between the cheap re-refine rung and the full
 #: fp32 refactorization: a TRANSIENT fault is repaired by re-executing
 #: one panel (checksum-guarded classic schedule) before the ladder pays
-#: for whole-solve escalation.
+#: for whole-solve escalation.  Since ISSUE 15 the serve layer's
+#: grid_qr escalation applies the same guarding to its least-squares QR
+#: (``least_squares(..., abft=True)``), so every factorization a serve
+#: escalation can reach is panel-recoverable.
 LADDER_NAMES = ("quant", "fast", "refine", "abft", "fp32", "classic")
 
 

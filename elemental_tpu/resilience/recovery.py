@@ -1,7 +1,9 @@
 """Panel-transaction recovery for the ABFT-guarded factorizations.
 
 The rollback half of ISSUE 11: :func:`run_step` wraps ONE panel step of
-an :mod:`.abft`-guarded driver as a transaction.  The step body is a
+an :mod:`.abft`-guarded driver (lu and cholesky since ISSUE 11, qr
+since ISSUE 15 -- every blocked factorization rides this runner) as a
+transaction.  The step body is a
 pure function ``state -> (state', *extras)`` over immutable jax arrays,
 so "snapshot" is free -- the pre-step state simply stays referenced --
 and rollback is "discard the attempt's outputs and call the body again".

@@ -31,10 +31,12 @@ so every request exercises the distributed certified path where the
 engine seams live (the big-problem serving mode).
 
 ISSUE 11 grows the matrix a ``qr`` op column (:func:`run_qr_cell`):
-serve admission only solves lu/hpd, so the qr cells drive
-``qr(..., health=True)`` directly under the same fault axes and grade
-detection against the ISSUE-9 health parity (see
-:data:`QR_DETECTED_KINDS` for the honest contract).
+serve admission only solves lu/hpd, so the qr cells drive the driver
+directly under the same fault axes.  ISSUE 15 upgrades the column to
+``qr(..., abft=True, health=True)``: detection now rides the
+Huang-Abraham checksum checks, ALL THREE kinds gate (bitflip included
+-- see :data:`QR_DETECTED_KINDS`), and each cell additionally pins the
+recovery contract (one recomputed panel, clean trusted residual).
 
 ISSUE 14 grows an **async** column: :func:`run_async_cell` drives the
 pipelined :class:`~.async_front.AsyncSolverService` with TWO batches in
@@ -340,33 +342,43 @@ def run_async_shutdown_cell(grid, *, n: int = 16, nrhs: int = 2,
             "violations": violations}, front
 
 
-#: the qr column's detection contract (ISSUE 11, riding ISSUE 9's
-#: qr health parity): 'nan' is caught by the nonfinite scan and 'scale'
-#: (x1e12) by the growth estimate -- a SILENT undetected corruption for
-#: either is a matrix violation.  'bitflip' is recorded but NOT gated:
-#: an exponent-bit flip that SHRINKS an element sits below the growth
-#: threshold, and catching it needs ABFT checksum checks -- which lu /
-#: cholesky now run (``abft=``) and qr does not yet (ROADMAP).
-QR_DETECTED_KINDS = ("scale", "nan")
+#: the qr column's detection contract (ISSUE 11 -> ISSUE 15): the cells
+#: run ``qr(..., abft=True)``, so ALL THREE kinds gate -- 'nan' and
+#: 'scale' were already caught by the health parity, and 'bitflip' (the
+#: former documented gap: a shrinking exponent-bit flip sits below the
+#: growth threshold) is now caught by the Huang-Abraham column-sum
+#: checks, exactly as for lu / cholesky.  A silent undetected corruption
+#: for ANY kind is a matrix violation.
+QR_DETECTED_KINDS = ("bitflip", "scale", "nan")
 
 
-def run_qr_cell(grid, *, kind: str, target: str, n: int = 24,
+def run_qr_cell(grid, *, kind: str, target: str, n: int = 16,
                 nb: int = 8, call: int = 0, nelem: int = 2,
                 seed: int = 13):
-    """One qr-column cell: ``qr(..., health=True)`` under a one-shot
-    fault, classified against a clean reference run.
+    """One qr-column cell: ``qr(..., abft=True, health=True)`` under a
+    one-shot fault, classified against a clean reference run.
 
     qr has no serve admission path (the service solves 'lu'/'hpd'), so
-    the column runs the driver directly: verdicts are ``absorbed`` (the
-    factor matches the clean run), ``surfaced`` (corrupted AND health
-    flagged it), or ``undetected`` (corrupted, no flag) -- the last is a
-    violation for :data:`QR_DETECTED_KINDS`.  Returns ``(cell, plan)``."""
+    the column runs the driver directly.  With ABFT guarding (ISSUE 15)
+    the EXPECTED verdict for every one-shot cell is ``absorbed``: the
+    checksum checks detect the corrupted panel, the transaction layer
+    re-executes it (``recompute_count == 1``), and the committed factor
+    is bit-identical to the clean run -- graded against a clean
+    ``64*n*eps``-class factorization residual besides the bitwise
+    comparison.  ``surfaced`` (corrupted but flagged through abft /
+    health) stays structured; ``undetected`` is a violation for every
+    kind in :data:`QR_DETECTED_KINDS` (all three, since ISSUE 15); a
+    landed fault that is neither recovered nor surfaced -- or a recovery
+    that costs more than the one corrupted panel -- is an
+    ``unrecovered`` violation.  Returns ``(cell, plan)``."""
     import jax
     import elemental_tpu as el
     from ..core.distmatrix import to_global
+    from ..resilience.abft import last_abft_report
     from ..resilience.health import HealthMonitor
 
     dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
+    eps = float(np.finfo(dtype).eps)
     rng = np.random.default_rng(seed)
     An = rng.normal(size=(n, n)).astype(dtype)
     clean = np.asarray(to_global(
@@ -376,14 +388,22 @@ def run_qr_cell(grid, *, kind: str, target: str, n: int = 24,
     mon = HealthMonitor()
     with fault_injection(plan):
         out = el.qr(el.from_global(An, el.MC, el.MR, grid=grid), nb=nb,
-                    health=mon)
+                    abft=True, health=mon)
     rep = mon.report()
+    arep = last_abft_report("qr")
     got = np.asarray(to_global(out[0]))
     with np.errstate(over="ignore", invalid="ignore"):
         same = bool(np.allclose(got, clean, rtol=1e-6, atol=1e-9,
                                 equal_nan=False))
-    detected = rep["ok"] is False
-    verdict = "absorbed" if same else \
+    # trusted recomputed factorization residual ||A - Q R|| / ||A||
+    Qg = np.asarray(to_global(el.explicit_q(out[0], out[1])))
+    with np.errstate(over="ignore", invalid="ignore"):
+        residual = float(np.linalg.norm(An - Qg @ np.triu(got))
+                         / np.linalg.norm(An))
+    res_ok = bool(np.isfinite(residual) and residual <= 64.0 * n * eps)
+    detected = (rep["ok"] is False) or (arep["ok"] is False) \
+        or bool(arep["violations"])
+    verdict = "absorbed" if same and res_ok else \
         ("surfaced" if detected else "undetected")
     violations = []
     if plan.fired() == 0:
@@ -392,12 +412,24 @@ def run_qr_cell(grid, *, kind: str, target: str, n: int = 24,
     if verdict == "undetected" and kind in QR_DETECTED_KINDS:
         violations.append({"kind": "silent_garbage",
                            "detail": f"qr {kind} corruption unflagged by "
-                                     f"health parity"})
+                                     f"abft/health"})
+    if plan.fired() and (verdict != "absorbed"
+                         or arep["recompute_count"] != 1):
+        violations.append(
+            {"kind": "unrecovered",
+             "detail": f"qr {kind}/{target} one-shot: verdict={verdict}, "
+                       f"recompute_count={arep['recompute_count']} "
+                       "(want absorbed at exactly one panel)"})
     return {"kind": kind, "target": target, "mode": "oneshot",
             "op": "qr", "requests": 1, "ok": int(same),
             "fired": plan.fired(), "budget_s": None,
             "outcomes": {"qr": verdict}, "verdict": verdict,
             "health_flags": [f["kind"] for f in rep["flags"]],
+            "abft": {"ok": arep["ok"],
+                     "violations": len(arep["violations"]),
+                     "recompute_count": arep["recompute_count"],
+                     "recovered_panels": arep["recovered_panels"]},
+            "residual": residual,
             "violations": violations}, plan
 
 
@@ -407,9 +439,9 @@ def chaos_matrix(grid, *, kinds=FAULT_KINDS, targets=CHAOS_TARGETS,
                  async_column: bool = True, **kw):
     """The full acceptance matrix -> ``chaos_report/v1``.
 
-    ``qr_column=True`` (default) appends the ISSUE-11 qr op column:
-    one :func:`run_qr_cell` per (kind, target), detection via the
-    ISSUE-9 health parity (see :data:`QR_DETECTED_KINDS`).
+    ``qr_column=True`` (default) appends the qr op column (ISSUE 11,
+    abft-guarded since ISSUE 15): one :func:`run_qr_cell` per
+    (kind, target), all kinds gated (:data:`QR_DETECTED_KINDS`).
 
     ``async_column=True`` (default) appends the ISSUE-14 async column:
     one mid-pipeline :func:`run_async_cell` per (kind, mode) on the

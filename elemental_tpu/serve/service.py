@@ -422,7 +422,11 @@ class SolverService:
         (``lapack.qr.least_squares``) with the same retry/backoff and
         trusted normal-equations certification as the square ladder
         (``certified_solve`` has no lstsq rung -- the grid solve IS the
-        stronger rung here)."""
+        stronger rung here).  The factorization runs ABFT-guarded
+        (ISSUE 15): a transient fault inside the escalation QR is
+        detected at the corrupted panel and repaired by one panel
+        re-execution instead of burning a whole serve retry -- every
+        escalation rung is now corruption-attested."""
         from ..core.dist import MC, MR
         from ..core.distmatrix import from_global, to_global
         from ..lapack.qr import least_squares
@@ -439,7 +443,7 @@ class SolverService:
                 return
             Ad = from_global(req.A, MC, MR, grid=g)
             Bd = from_global(req.B, MC, MR, grid=g)
-            Xd = least_squares(Ad, Bd, nb=self.escalate_nb)
+            Xd = least_squares(Ad, Bd, nb=self.escalate_nb, abft=True)
             X = np.array(to_global(Xd), dtype=np.float64)  # owned copy
             res = ls_residual(req.A, req.B, X)
             _metrics.inc("serve_escalations", op=req.op, rung="grid_qr")

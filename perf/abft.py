@@ -1,5 +1,5 @@
-"""ABFT-guarded factorization CLI (ISSUE 11): run a checksum-guarded
-lu/cholesky, print the ``abft_report/v1``; optionally under
+"""ABFT-guarded factorization CLI (ISSUE 11 + 15): run a checksum-
+guarded lu/cholesky/qr, print the ``abft_report/v1``; optionally under
 deterministic (windowed) fault injection.
 
 The command-line face of ``elemental_tpu/resilience/abft``:
@@ -13,13 +13,14 @@ The command-line face of ``elemental_tpu/resilience/abft``:
                                             # quantized wire: widened
                                             #   thresholds, still zero
                                             #   violations on clean data
-    python -m perf.abft run lu --fault redistribute:nan --window 1:2
-                                            # corrupt panel step 1; watch
-                                            #   detection AND the single
-                                            #   panel re-execution
+    python -m perf.abft run qr --fault compute:bitflip --window 1:2
+                                            # corrupt the panel factor at
+                                            #   step 1; watch detection
+                                            #   AND the single panel
+                                            #   re-execution
     python -m perf.abft smoke               # the tools/check.sh gate:
                                             #   clean guarded runs on 1x1
-                                            #   AND 2x2 for lu+cholesky
+                                            #   AND 2x2 for lu+cholesky+qr
                                             #   (zero violations), plus
                                             #   one injected fault per op
                                             #   recovered at panel
@@ -64,6 +65,11 @@ def _residual(op, M, out):
         U = np.triu(g)
         return float(np.linalg.norm(M[np.asarray(perm)] - L @ U)
                      / np.linalg.norm(M))
+    if op == "qr":
+        Ap, tau = out
+        Q = np.asarray(el.to_global(el.explicit_q(Ap, tau)))
+        R = np.triu(np.asarray(el.to_global(Ap)))
+        return float(np.linalg.norm(M - Q @ R) / np.linalg.norm(M))
     Lg = np.asarray(el.to_global(out))
     return float(np.linalg.norm(M - Lg @ Lg.conj().T) / np.linalg.norm(M))
 
@@ -76,10 +82,15 @@ def _run_one(op, n, nb, grid, dtype, faults, seed, retries,
                                           fault_injection)
     M, A = _build(op, n, dtype, grid)
     guard = AbftGuard(max_retries=retries)
-    drv = (lambda: el.lu(A, nb=nb, abft=guard,
-                         comm_precision=comm_precision)) if op == "lu" \
-        else (lambda: el.cholesky(A, nb=nb, abft=guard,
-                                  comm_precision=comm_precision))
+    if op == "lu":
+        drv = lambda: el.lu(A, nb=nb, abft=guard,
+                            comm_precision=comm_precision)
+    elif op == "qr":
+        drv = lambda: el.qr(A, nb=nb, abft=guard,
+                            comm_precision=comm_precision)
+    else:
+        drv = lambda: el.cholesky(A, nb=nb, abft=guard,
+                                  comm_precision=comm_precision)
     t0 = time.perf_counter()
     if faults:
         plan = FaultPlan(seed=seed, faults=faults)
@@ -132,16 +143,18 @@ def cmd_run(op, n, nb, grid_spec, dtype, faults, seed, retries,
 
 
 def cmd_smoke() -> int:
-    """The check.sh gate: clean guarded runs on 1x1 and 2x2 for both ops
-    (zero violations, zero recomputes) + one windowed fault per op that
-    must be detected at the injected panel and repaired by exactly ONE
-    panel re-execution.  Small n, CPU-safe, exit 1 on any violation."""
+    """The check.sh gate: clean guarded runs on 1x1 and 2x2 for all
+    three ops (zero violations, zero recomputes) + one windowed fault
+    per op that must be detected at the injected panel and repaired by
+    exactly ONE panel re-execution -- qr's injected kind is a bitflip,
+    the class only checksums catch.  Small n, CPU-safe, exit 1 on any
+    violation."""
     from elemental_tpu.resilience import FaultSpec
     rc = 0
     n, nb = 32, 8
     for spec in ("1x1", "2x2"):
         grid = _grid(spec)
-        for op in ("lu", "hpd"):
+        for op in ("lu", "hpd", "qr"):
             rep, res, _, secs = _run_one(op, n, nb, grid, "float32", (),
                                          0, 2)
             clean = (rep["ok"] and not rep["violations"]
@@ -153,9 +166,13 @@ def cmd_smoke() -> int:
             if not clean:
                 rc = 1
     # one injected fault per op on the 2x2 grid: panel-granular recovery
+    # (qr's cell is a BITFLIP -- the kind only the ISSUE-15 checksums
+    # catch; health growth/nonfinite guards cannot see it)
     grid = _grid("2x2")
-    for op, target in (("lu", "redistribute"), ("hpd", "compute")):
-        fault = FaultSpec(target, "scale", nelem=2, window=(1, 2))
+    for op, target, kind in (("lu", "redistribute", "scale"),
+                             ("hpd", "compute", "scale"),
+                             ("qr", "compute", "bitflip")):
+        fault = FaultSpec(target, kind, nelem=2, window=(1, 2))
         rep, res, plan, _ = _run_one(op, n, nb, grid, "float32", (fault,),
                                      7, 2)
         steps = sorted({v["step"] for v in rep["violations"]})
@@ -163,7 +180,7 @@ def cmd_smoke() -> int:
                 and rep["recompute_count"] == 1
                 and rep["recovered_panels"] == [1]
                 and rep["ok"] and res < 1e-4)
-        print(f"# smoke fault({op} {target} scale@panel1): "
+        print(f"# smoke fault({op} {target} {kind}@panel1): "
               f"fired={plan.fired()} viol_steps={steps} "
               f"recompute={rep['recompute_count']} "
               f"recovered={rep['recovered_panels']} residual={res:.2e} "
@@ -220,12 +237,12 @@ def main(argv=None) -> int:
         else:
             pos.append(arg)
     if not pos:
-        raise SystemExit("run needs an op (lu/hpd)")
+        raise SystemExit("run needs an op (lu/hpd/qr)")
     op = pos.pop(0)
     if op == "cholesky":
         op = "hpd"
-    if op not in ("lu", "hpd"):
-        raise SystemExit(f"unknown op {op!r}; expected lu or hpd")
+    if op not in ("lu", "hpd", "qr"):
+        raise SystemExit(f"unknown op {op!r}; expected lu, hpd, or qr")
     if pos and n is None:
         n = int(pos.pop(0))
     n = 128 if n is None else n

@@ -25,7 +25,10 @@ The command-line face of ``elemental_tpu/serve``:
                                             #   {bitflip,scale,nan} x
                                             #   {redistribute,compute} x
                                             #   {oneshot,persistent} plus
-                                            #   the qr op column and the
+                                            #   the abft-guarded qr op
+                                            #   column (ISSUE 15: all
+                                            #   kinds gate, one-panel
+                                            #   recovery pinned) and the
                                             #   ISSUE-14 async column
                                             #   (mid-pipeline isolation +
                                             #   hard-stop flush):

@@ -113,7 +113,10 @@ def test_lu_lookahead_matches_classic(grid24, shape):
                                rtol=1e-12, atol=1e-12)
 
 
-@pytest.mark.parametrize("shape", [(48, 48), (40, 40), (48, 32), (32, 48)])
+@pytest.mark.parametrize("shape", [
+    pytest.param((48, 48), marks=pytest.mark.slow),
+    pytest.param((40, 40), marks=pytest.mark.slow),
+    (48, 32), (32, 48)])
 def test_lu_crossover_boundary(grid24, shape):
     """Tail crossover-to-local at thresholds just below / at / above the
     remaining-block sizes: pivots match classic exactly and factors to

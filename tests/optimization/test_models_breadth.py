@@ -5,6 +5,7 @@ Oracles follow SURVEY.md §5: scipy/HiGHS objective agreement where an LP
 oracle exists, otherwise optimality conditions / known closed forms.
 """
 import numpy as np
+import pytest
 
 import elemental_tpu as el
 
@@ -62,6 +63,7 @@ def test_en_elastic_net(grid24):
         assert f0 <= obj(x + 1e-3 * rng.normal(size=n)) + 1e-9
 
 
+@pytest.mark.slow
 def test_nmf(grid24):
     rng = np.random.default_rng(3)
     m, n, rk = 30, 24, 4
@@ -76,6 +78,7 @@ def test_nmf(grid24):
     assert np.linalg.norm(Wg @ Hg - X) / np.linalg.norm(X) < 5e-2
 
 
+@pytest.mark.slow
 def test_sparse_inv_cov(grid24):
     rng = np.random.default_rng(4)
     n, N = 10, 4000
@@ -118,6 +121,7 @@ def test_long_only_portfolio(grid24):
         assert obj(x) <= obj(e) + 1e-6
 
 
+@pytest.mark.slow
 def test_tv_denoise(grid24):
     rng = np.random.default_rng(6)
     n = 60

@@ -72,6 +72,7 @@ def test_qp_equality(grid24):
     assert float(xg.T @ zg) < 1e-6
 
 
+@pytest.mark.slow
 def test_nnls(grid24):
     rng = np.random.default_rng(3)
     A = rng.normal(size=(20, 10))

@@ -1,10 +1,13 @@
-"""ABFT checksum-guarded factorizations (ISSUE 11): the acceptance
+"""ABFT checksum-guarded factorizations (ISSUE 11 + 15): the acceptance
 matrix {bitflip, scale, nan} x {redistribute, compute} inside
-abft-enabled lu/cholesky detects at the injected panel and recovers by
-re-executing ONLY that panel (recompute_count == 1), the abft=None path
-is bit-identical to the plain drivers, quantized wire produces no false
-positives, and unrecovered persistent faults surface through
-health_report/v1."""
+abft-enabled lu/cholesky/qr detects at the injected panel and recovers
+by re-executing ONLY that panel (recompute_count == 1), the abft=None
+path is bit-identical to the plain drivers, quantized wire produces no
+false positives, and unrecovered persistent faults surface through
+health_report/v1.  ISSUE 15 grows the matrix the qr op: both panel
+strategies ('classic' larfg and the 'tsqr' tree) are guarded, and
+``FaultSpec(window=)`` step scoping works for qr exactly as for
+lu/cholesky (the transaction layer announces panel steps)."""
 import numpy as np
 import pytest
 
@@ -42,6 +45,12 @@ def _chol_residual(M, Lc):
     return np.linalg.norm(M - Lg @ Lg.conj().T) / np.linalg.norm(M)
 
 
+def _qr_residual(M, Ap, tau):
+    Q = np.asarray(to_global(el.explicit_q(Ap, tau)))
+    R = np.triu(np.asarray(to_global(Ap)))
+    return np.linalg.norm(M - Q @ R) / np.linalg.norm(M)
+
+
 # ---------------------------------------------------------------------
 # clean guarded runs: ok reports, zero violations, bitwise-plain output
 # ---------------------------------------------------------------------
@@ -65,6 +74,20 @@ def test_clean_cholesky_abft_ok(grid24):
     assert rep["ok"] is True and rep["driver"] == "cholesky"
     assert rep["violations"] == [] and rep["recompute_count"] == 0
     assert _chol_residual(M, Lc) < 1e-5
+
+
+@pytest.mark.parametrize("panel", ["classic", "tsqr"])
+def test_clean_qr_abft_ok(grid24, panel):
+    """Both panel strategies are guarded: the TSQR tree preserves column
+    sums leaf-to-root, so the single reconstruction check covers it."""
+    M = _build("lu", 12)
+    Ap, tau = el.qr(_dist(grid24, M), nb=4, panel=panel, abft=True)
+    rep = last_abft_report("qr")
+    assert rep["schema"] == ABFT_SCHEMA
+    assert rep["ok"] is True and rep["driver"] == "qr"
+    assert rep["panels"] == 3 and rep["checks"] > 0
+    assert rep["violations"] == [] and rep["recompute_count"] == 0
+    assert _qr_residual(M, Ap, tau) < 1e-5
 
 
 def test_report_schema_pin(grid24):
@@ -95,6 +118,21 @@ def test_abft_true_output_bitwise_plain(grid24):
                                          abft=True))))
 
 
+def test_qr_abft_output_bitwise_plain(grid24):
+    """qr's guarded path only OBSERVES too: same blocked Householder
+    schedule, so plain qr IS the bitwise reference (no lookahead to
+    disable), and abft=None stays the plain dispatch."""
+    M = _build("lu", 16, dtype=np.float64, seed=3)
+    Ap0, tau0 = el.qr(_dist(grid24, M), nb=4)
+    Ap1, tau1 = el.qr(_dist(grid24, M), nb=4, abft=True)
+    Ap2, tau2 = el.qr(_dist(grid24, M), nb=4, abft=None)
+    np.testing.assert_array_equal(np.asarray(to_global(Ap0)),
+                                  np.asarray(to_global(Ap1)))
+    np.testing.assert_array_equal(np.asarray(tau0), np.asarray(tau1))
+    np.testing.assert_array_equal(np.asarray(to_global(Ap0)),
+                                  np.asarray(to_global(Ap2)))
+
+
 def test_abft_none_is_plain_dispatch(grid24):
     """abft=None is the NULL path: same code, bit-identical output."""
     M = _build("lu", 16, dtype=np.float64, seed=5)
@@ -112,12 +150,13 @@ def test_abft_none_is_plain_dispatch(grid24):
 
 @pytest.mark.parametrize("kind", ["bitflip", "scale", "nan"])
 @pytest.mark.parametrize("target", ["redistribute", "compute"])
-@pytest.mark.parametrize("op", ["lu", "hpd"])
+@pytest.mark.parametrize("op", ["lu", "hpd", "qr"])
 def test_acceptance_matrix_panel_recovery(grid24, op, target, kind):
-    """The ISSUE-11 acceptance pin: a one-shot fault scoped to panel
-    step 1 is detected AT step 1 and repaired by exactly ONE panel
-    re-execution (the recovery-cost counter), with a clean factor."""
-    n = 16
+    """The ISSUE-11 acceptance pin, grown the qr op by ISSUE 15: a
+    one-shot fault scoped to panel step 1 is detected AT step 1 and
+    repaired by exactly ONE panel re-execution (the recovery-cost
+    counter), with a clean factor."""
+    n = 12
     M = _build(op, n)
     plan = FaultPlan(seed=7, faults=[
         FaultSpec(target, kind, nelem=2, window=(1, 2))])
@@ -126,6 +165,10 @@ def test_acceptance_matrix_panel_recovery(grid24, op, target, kind):
             LU, perm = el.lu(_dist(grid24, M), nb=4, abft=True)
             rep = last_abft_report("lu")
             res = _lu_residual(M, LU, perm)
+        elif op == "qr":
+            Ap, tau = el.qr(_dist(grid24, M), nb=4, abft=True)
+            rep = last_abft_report("qr")
+            res = _qr_residual(M, Ap, tau)
         else:
             Lc = el.cholesky(_dist(grid24, M), nb=4, abft=True)
             rep = last_abft_report("cholesky")
@@ -157,12 +200,15 @@ def test_violation_doc_shape(grid24):
 # quantized wire: the widened threshold absorbs block-scaled rounding
 # ---------------------------------------------------------------------
 
-@pytest.mark.parametrize("op", ["lu", "hpd"])
+@pytest.mark.parametrize("op", ["lu", "hpd", "qr"])
 def test_quantized_wire_no_false_positives(grid24, op):
-    M = _build(op, 32, dtype=np.float64, seed=9)
+    M = _build(op, 16, dtype=np.float64, seed=9)
     if op == "lu":
         el.lu(_dist(grid24, M), nb=8, abft=True, comm_precision="bf16")
         rep = last_abft_report("lu")
+    elif op == "qr":
+        el.qr(_dist(grid24, M), nb=8, abft=True, comm_precision="bf16")
+        rep = last_abft_report("qr")
     else:
         el.cholesky(_dist(grid24, M), nb=8, abft=True,
                     comm_precision="bf16")
@@ -194,6 +240,72 @@ def test_persistent_fault_surfaces_through_health(grid24):
     flags = [f for f in hrep["flags"] if f["kind"] == "abft"]
     assert flags
     assert hrep["failing_phase"] == flags[0]["phase"]
+
+
+def test_qr_persistent_fault_surfaces_through_health(grid24):
+    M = _build("qr", 12)
+    mon = HealthMonitor()
+    plan = FaultPlan(seed=7, faults=[
+        FaultSpec("redistribute", "nan", every=True, nelem=2)])
+    with fault_injection(plan):
+        el.qr(_dist(grid24, M), nb=4, abft=AbftGuard(max_retries=1),
+              health=mon)
+    rep = last_abft_report("qr")
+    assert rep["ok"] is False
+    assert rep["unrecovered_panels"]
+    assert rep["recompute_count"] >= rep["max_retries"]
+    hrep = mon.report()
+    assert hrep["ok"] is False
+    flags = [f for f in hrep["flags"] if f["kind"] == "abft"]
+    assert flags
+    assert hrep["failing_phase"] == flags[0]["phase"]
+
+
+# ---------------------------------------------------------------------
+# qr specifics: the tsqr tree panel recovers too, and FaultSpec window
+# step-scoping works for qr exactly as for lu/cholesky (satellite: the
+# transaction layer announces panel steps, fires exactly once, replays
+# bit-identically)
+# ---------------------------------------------------------------------
+
+def test_qr_tsqr_panel_recovery(grid24):
+    """The TSQR tree panel is guarded by the same reconstruction check:
+    a corrupted tree output violates the packed-factor invariant and the
+    panel re-executes."""
+    M = _build("qr", 12)
+    plan = FaultPlan(seed=7, faults=[
+        FaultSpec("compute", "scale", nelem=2, window=(1, 2))])
+    with fault_injection(plan):
+        Ap, tau = el.qr(_dist(grid24, M), nb=4, panel="tsqr", abft=True)
+    rep = last_abft_report("qr")
+    assert plan.fired() >= 1
+    assert sorted({v["step"] for v in rep["violations"]}) == [1]
+    assert rep["recompute_count"] == 1
+    assert rep["recovered_panels"] == [1] and rep["ok"] is True
+    assert _qr_residual(M, Ap, tau) < 1e-5
+
+
+def test_qr_windowed_fault_fires_once_replay_identical(grid24):
+    """window=(1, 2) scopes the one-shot to panel step 1 -- it fires
+    EXACTLY once (the qr schedule announces steps through the
+    transaction layer), and a same-seed replay is bit-identical in both
+    fault log and committed factor."""
+    from elemental_tpu.resilience import logs_identical
+    M = _build("qr", 12, dtype=np.float64, seed=5)
+
+    def run():
+        plan = FaultPlan(seed=7, faults=[
+            FaultSpec("redistribute", "bitflip", nelem=2, window=(1, 2))])
+        with fault_injection(plan):
+            Ap, tau = el.qr(_dist(grid24, M), nb=4, abft=True)
+        return plan, np.asarray(to_global(Ap)), np.asarray(tau)
+
+    p1, A1, t1 = run()
+    p2, A2, t2 = run()
+    assert p1.fired() == 1 and p2.fired() == 1
+    assert logs_identical(p1, p2)
+    np.testing.assert_array_equal(A1, A2)
+    np.testing.assert_array_equal(t1, t2)
 
 
 # ---------------------------------------------------------------------

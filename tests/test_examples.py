@@ -26,8 +26,16 @@ _CASES = [
 ]
 
 
-@pytest.mark.parametrize("script,argv,metrics",
-                         _CASES, ids=[c[0] for c in _CASES])
+#: the two heaviest example scripts ride the slow tier (they exercise
+#: svd/schur stacks already covered by their own lapack suites).
+_SLOW_EXAMPLES = {"rpca.py", "pseudospectra.py"}
+
+
+@pytest.mark.parametrize(
+    "script,argv,metrics",
+    [pytest.param(*c, id=c[0],
+                  marks=(pytest.mark.slow,) if c[0] in _SLOW_EXAMPLES
+                  else ()) for c in _CASES])
 def test_example(script, argv, metrics, capsys):
     old_argv = sys.argv
     sys.argv = [script] + argv

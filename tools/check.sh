@@ -151,14 +151,16 @@ if [ "$what" = "all" ] || [ "$what" = "resilience" ]; then
 fi
 
 if [ "$what" = "all" ] || [ "$what" = "abft" ]; then
-    echo "== abft smoke (guarded lu + cholesky, clean + injected, CPU-safe) =="
+    echo "== abft smoke (guarded lu + cholesky + qr, clean + injected, CPU-safe) =="
     # clean guarded runs: zero violations, zero recomputes; a windowed
     # one-shot fault must be detected AT the injected panel and repaired
-    # by exactly ONE panel re-execution
+    # by exactly ONE panel re-execution (qr's injected kind is a bitflip,
+    # the class only the ISSUE-15 checksums catch)
     JAX_PLATFORMS=cpu python -m perf.abft smoke || rc=1
-    echo "== abft comm-plan goldens (lu_abft / cholesky_abft, 1x1 + 2x2) =="
+    echo "== abft comm-plan goldens (lu_abft / cholesky_abft / qr_abft, 1x1 + 2x2) =="
     JAX_PLATFORMS=cpu python -m perf.comm_audit diff lu_abft || rc=1
     JAX_PLATFORMS=cpu python -m perf.comm_audit diff cholesky_abft || rc=1
+    JAX_PLATFORMS=cpu python -m perf.comm_audit diff qr_abft || rc=1
     echo "== abft tier-1 tests (detection/recovery acceptance matrix) =="
     python -m pytest tests/resilience/test_abft.py -q -m 'not slow' -p no:cacheprovider || rc=1
 fi
