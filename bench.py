@@ -1,8 +1,9 @@
 """Driver benchmark: blocked Cholesky + HPL-style LU TFLOPS on the local chip.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} for the
-headline Cholesky config, plus "lu_*" keys for the LU entry (the driver
-metric names both).  vs_baseline = measured TFLOP/s / north-star (60% of the
+headline Cholesky config, plus "lu_*" keys for the LU entry and "gemm_*"
+keys for the tall-skinny rectangular GEMM entry (ISSUE 16; the driver
+metric names all three).  vs_baseline = measured TFLOP/s / north-star (60% of the
 chip's fp32-class matmul peak; BASELINE.json "north_star").  fp32-class =
 HIGHEST precision (6-pass bf16), so the peak table is bf16-peak / 6.
 
@@ -217,6 +218,49 @@ def main():
                           "cholesky_value": round(chol_tflops, 3)}))
         return 1
 
+    # ---- rectangular GEMM (ISSUE 16: the tall-skinny headline) --------
+    # The serving tier's real matmul class: m >> n.  alg='auto' so the
+    # timed run IS the tuner's dispatch (provenance recorded below --
+    # 'dot' on this single-chip grid via the pinned early-out, 'slice'
+    # on the multi-chip tall-skinny grids).
+    m_g, k_g, n_g = (65536, 512, 512) if on_tpu else (4096, 128, 128)
+
+    @jax.jit
+    def gen_gemm():
+        return (jax.random.normal(jax.random.PRNGKey(4), (m_g, k_g),
+                                  jnp.float32),
+                jax.random.normal(jax.random.PRNGKey(5), (k_g, n_g),
+                                  jnp.float32))
+
+    def wrap_gemm(ab):
+        a, b = ab
+        return (el.DistMatrix(a, (m_g, k_g), el.MC, el.MR, 0, 0, grid),
+                el.DistMatrix(b, (k_g, n_g), el.MC, el.MR, 0, 0, grid))
+
+    gemm_fn = jax.jit(
+        lambda ab: el.gemm(ab[0], ab[1], alg="auto", precision=HI).local,
+        donate_argnums=0)
+    c_arr, dt_g = timed(lambda: wrap_gemm(gen_gemm()), gemm_fn)
+    gemm_tflops = 2 * m_g * k_g * n_g / dt_g / 1e12
+
+    @jax.jit
+    def gemm_resid_fn(c_loc):
+        a, b = gen_gemm()
+        v = jax.random.normal(jax.random.PRNGKey(6), (n_g, 1), jnp.float32)
+        r = jnp.matmul(c_loc, v, precision=HI) \
+            - jnp.matmul(a, jnp.matmul(b, v, precision=HI), precision=HI)
+        return jnp.linalg.norm(r) / (jnp.linalg.norm(a) * jnp.linalg.norm(b)
+                                     * jnp.linalg.norm(v))
+
+    gemm_resid = float(gemm_resid_fn(c_arr))
+    del c_arr
+    if gemm_resid > 1e-3 or gemm_resid != gemm_resid:
+        print(json.dumps({"metric": f"cholesky_n{n_chol}_tflops_per_chip",
+                          "value": round(chol_tflops, 3), "unit": "TFLOP/s",
+                          "error": f"gemm residual {gemm_resid:.3e}",
+                          "lu_value": round(lu_tflops, 3)}))
+        return 1
+
     # Tuner self-description (ISSUE 4 + 6): record the config the autotuner
     # resolves for each headline op -- and whether it came from a measured
     # cache entry or the analytic cost model -- so this BENCH line says
@@ -233,7 +277,9 @@ def main():
                                 "redist_path": None}}
     try:
         from elemental_tpu import tune as el_tune
-        for op, nn in (("cholesky", n_chol), ("lu", n_lu)):
+        for op, gshape in (("cholesky", (n_chol, n_chol)),
+                           ("lu", (n_lu, n_lu)),
+                           ("gemm", (m_g, k_g, n_g))):
             # comm_precision joins the resolved provenance (ISSUE 8): on
             # this single-chip grid 'auto' resolves to None (the knob is
             # dead without collectives); a multi-device bench records the
@@ -243,13 +289,22 @@ def main():
             # (every plan is 'local'), and a multi-chip bench records the
             # arbiter's pick (measured constants when recorded, the ring
             # model otherwise) next to nb/panel
-            requested = {"nb": "auto", "lookahead": "auto",
-                         "crossover": "auto", "comm_precision": "auto",
-                         "redist_path": "auto"}
-            if op == "lu":
-                requested["panel"] = "auto"
+            if op == "gemm":
+                # the gemm headline's provenance (ISSUE 16): which alg
+                # family the tuner dispatched the tall-skinny class to --
+                # 'dot' on this single-chip grid (pinned early-out),
+                # 'slice' on multi-chip tall-skinny grids
+                requested = {"alg": "auto", "nb": "auto",
+                             "comm_precision": "auto",
+                             "redist_path": "auto"}
+            else:
+                requested = {"nb": "auto", "lookahead": "auto",
+                             "crossover": "auto", "comm_precision": "auto",
+                             "redist_path": "auto"}
+                if op == "lu":
+                    requested["panel"] = "auto"
             res = el_tune.resolve(
-                op, gshape=(nn, nn), dtype=jnp.float32, grid=grid,
+                op, gshape=gshape, dtype=jnp.float32, grid=grid,
                 requested=requested)
             tuner[op] = {"config": dict(res.config), "source": res.source}
         tuner["cache_dir"] = el_tune.cache_dir()
@@ -327,12 +382,17 @@ def main():
         "lu_metric": f"lu_n{n_lu}_tflops_per_chip",
         "lu_value": round(lu_tflops, 3),
         "lu_vs_baseline": round(lu_tflops / north_star, 4),
+        "gemm_metric": "gemm_tall_skinny_tflops_per_chip",
+        "gemm_value": round(gemm_tflops, 3),
+        "gemm_vs_baseline": round(gemm_tflops / north_star, 4),
+        "gemm_dims": [m_g, k_g, n_g],
         "vs_nameplate": round(chol_tflops / (0.6 * table_peak), 4),
         "lu_vs_nameplate": round(lu_tflops / (0.6 * table_peak), 4),
         "roofline_tflops": round(roofline, 2),
         "nameplate_tflops": round(table_peak, 2),
         "resid": f"{resid:.2e}",
         "lu_resid": f"{lu_resid:.2e}",
+        "gemm_resid": f"{gemm_resid:.2e}",
         "tuner": tuner,
         "obs": obs_doc,
     }))

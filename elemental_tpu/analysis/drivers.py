@@ -86,6 +86,39 @@ def _gemm_spec(alg, variant="", redist_path=None):
     return DriverSpec(f"{name}_{variant}" if variant else name, build)
 
 
+#: the slicing-gemm driver's rectangular trace geometry, as multiples of
+#: the ``n`` trace parameter: (m, k, n) = (32n, n, n/4) -- the tall-skinny
+#: class (m >> n, k = 4*cols) where ISSUE 16 pins the slice schedule at
+#: strictly fewer collective rounds and >= 1.5x fewer wire bytes than the
+#: stationary-C twin on BOTH golden grids (the twin ratio grows with m/n).
+GEMM_SLICE_DIMS = (32, 1, 0.25)
+
+
+def gemm_slice_extents(n: int) -> tuple:
+    """(m, k, n') of the gemm_slice trace at trace parameter ``n``."""
+    sm, sk, sn = GEMM_SLICE_DIMS
+    return int(sm * n), int(sk * n), max(int(sn * n), 1)
+
+
+def _gemm_slice_spec():
+    """The slicing gemm (ISSUE 16) traces TALL-SKINNY, not square: its
+    whole reason to exist is the rectangular regime, so the golden pins
+    live where 'auto' would actually dispatch it."""
+    def build(grid, n, nb, dtype):
+        from ..blas.level3 import gemm
+        m, k, n2 = gemm_slice_extents(n)
+
+        def fn(a, b):
+            A = _as_dm(a, grid, m, k)
+            B = _as_dm(b, grid, k, n2)
+            return gemm(A, B, alg="slice", nb=nb)
+        args = (_mcmr_input(grid, m, k, dtype),
+                _mcmr_input(grid, k, n2, dtype))
+        meta = {"alg": "slice", "extents": [m, k, n2]}
+        return fn, args, meta
+    return DriverSpec("gemm_slice", build)
+
+
 def _trsm_spec(variant="", side="L", redist_path=None):
     def build(grid, n, nb, dtype):
         from ..blas.level3 import trsm
@@ -231,7 +264,7 @@ def _qr_spec(variant="", panel="classic", abft=False):
 def _registry() -> dict:
     specs = [
         _gemm_spec("A"), _gemm_spec("B"), _gemm_spec("C"),
-        _gemm_spec("dot"), _gemm_spec("gspmd"),
+        _gemm_spec("dot"), _gemm_spec("gspmd"), _gemm_slice_spec(),
         _trsm_spec(),
         _herk_spec(),
         # classic = right-looking baseline; lookahead = pure pipeline

@@ -95,9 +95,12 @@ def gemm(A: DistMatrix, B: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None
     winner first, else the closed-form ring-model cost comparison of the
     SUMMA schedules -- the principled version of the reference's
     largest-operand-stationary heuristic in ``Gemm.cpp``), or one of
-    'A' / 'B' / 'C' / 'dot' / 'gspmd' explicitly ('gspmd' = single
-    storage matmul, XLA chooses the schedule).  ``nb='auto'`` likewise
-    asks the tuner for the panel width; an explicit value always wins.
+    'A' / 'B' / 'C' / 'dot' / 'gspmd' / 'slice' explicitly ('gspmd' =
+    single storage matmul, XLA chooses the schedule; 'slice' = the
+    one-sided slicing schedule of :func:`_summa_slice` -- three one-shot
+    compiled plans, no ring, the tall-skinny/rectangular winner).
+    ``nb='auto'`` likewise asks the tuner for the panel width; an
+    explicit value always wins ('dot', 'gspmd' and 'slice' ignore it).
 
     ``comm_precision`` (``None`` | ``'bf16'`` | ``'int8'`` | ``'auto'``)
     selects the wire precision of the SUMMA panel moves (the per-panel
@@ -165,6 +168,8 @@ def gemm(A: DistMatrix, B: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None
         return _summa_b(alpha, A, B, beta, C, nb, precision, tm, cp, rp)
     if alg == "dot":
         return _summa_dot(alpha, A, B, beta, C, precision, tm, cp, rp)
+    if alg == "slice":
+        return _summa_slice(alpha, A, B, beta, C, precision, tm, cp)
     if alg == "gspmd":
         # one-shot: re-land B's k-rows on A's k-col cyclic order ([MR,STAR]),
         # then a single storage matmul -- GSPMD inserts the psum over mr.
@@ -172,7 +177,9 @@ def gemm(A: DistMatrix, B: DistMatrix, alpha=1.0, beta=0.0, C: DistMatrix | None
         d = jnp.matmul(A.local, Bk.local, precision=precision)
         D = DistMatrix(d, (m, n), MC, STAR, 0, 0, A.grid)
         out = redistribute(D, MC, MR)
-        res = C.with_local(_safe_astype(alpha * out.local + beta * C.local, C.dtype))
+        res = C.with_local(_safe_astype(
+            alpha * out.local + (beta * C.local if _nonzero(beta) else 0),
+            C.dtype))
         tm.tick("panel", 0, res.local)
         return res
     raise ValueError(f"unknown gemm alg {alg!r}")
@@ -209,7 +216,8 @@ def _summa_a(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK, cp=None,
     n = B.gshape[1]
     r, c = A.grid.height, A.grid.width
     jb = _blocksize(nb, c, n)
-    out = C.with_local(beta * C.local if _nonzero(beta) else jnp.zeros_like(C.local))
+    out = C.with_local(_safe_astype(beta * C.local, C.dtype)
+                       if _nonzero(beta) else jnp.zeros_like(C.local))
     for i, s in enumerate(range(0, n, jb)):
         e = min(s + jb, n)
         B1 = redistribute(view(B, cols=(s, e)), MR, STAR,
@@ -233,7 +241,8 @@ def _summa_b(alpha, A, B, beta, C, nb, precision, tm=_NULL_HOOK, cp=None,
     n = B.gshape[1]
     r, c = A.grid.height, A.grid.width
     ib = _blocksize(nb, r, m)
-    out = C.with_local(beta * C.local if _nonzero(beta) else jnp.zeros_like(C.local))
+    out = C.with_local(_safe_astype(beta * C.local, C.dtype)
+                       if _nonzero(beta) else jnp.zeros_like(C.local))
     for i, s in enumerate(range(0, m, ib)):
         e = min(s + ib, m)
         A1T = redistribute(transpose_dist(view(A, rows=(s, e))), MC, STAR,
@@ -269,6 +278,58 @@ def _summa_dot(alpha, A, B, beta, C, precision, tm=_NULL_HOOK, cp=None,
         dl = jnp.matmul(Avc.local, Bvc.local, precision=precision)
         D = DistMatrix(dl, (m, n), STAR, STAR, 0, 0, A.grid)
         d = redistribute(D, MC, MR).local
+    res = C.with_local(_safe_astype(
+        alpha * d + (beta * C.local if _nonzero(beta) else 0),
+        C.dtype))
+    tm.tick("panel", 0, res.local)
+    return res
+
+
+def _summa_slice(alpha, A, B, beta, C, precision, tm=_NULL_HOOK, cp=None):
+    """Slicing-based one-sided gemm (``alg='slice'``, the arXiv 2510.08874
+    direction): every device owns one contiguous-cyclic SLICE of C's rows
+    (or columns) and gathers, in ONE compiled one-shot plan per operand,
+    exactly the A rows (B columns) that slice needs plus the shared small
+    operand -- no k-panel ring, no per-panel barrier.
+
+    Row mode (``m >= n`` or an Nx1 grid): A -> [VC,STAR] (each device
+    takes its 1-D cyclic row slice -- a single ragged FFD-packed a2a over
+    mr), B -> [STAR,STAR] (the small operand, one exchange), then a fully
+    LOCAL contraction (k is unsharded on both sides, so no hidden psum)
+    lands D = A_slice @ B as [VC,STAR] storage, filtered back onto
+    [MC,MR] by a third one-shot plan.  Column mode mirrors with
+    [STAR,STAR] x [STAR,VR].  Degeneracies: 1x1 grids early-out to one
+    local matmul with ZERO redistributes (pinned); on Nx1 (row mode) and
+    1xN (column mode) grids two of the three plans are pure local
+    filters, leaving a single collective.
+
+    The slice gathers ride the plan compiler natively
+    (``path='direct'``), so ``comm_precision`` composes PER SLOT -- bf16
+    cast or int8 block-scale-pack on every packed a2a slot -- and the
+    ``redist_path`` knob is moot: the gather IS a one-shot plan.  The
+    tuner prices the three plans with the same ``compile_plan`` byte
+    math (``tune.cost_model``), which is what makes ``alg='auto'`` pick
+    'slice' on tall-skinny / non-square-grid geometry and keep the SUMMA
+    twins elsewhere."""
+    m, n = C.gshape
+    g = A.grid
+    if g.size == 1:
+        d = jnp.matmul(A.local, B.local, precision=precision)
+    else:
+        from ..redist.plan import slice_row_mode
+        if slice_row_mode(m, n, (g.height, g.width)):
+            As = redistribute(A, VC, STAR, comm_precision=cp, path="direct")
+            Bs = redistribute(B, STAR, STAR, comm_precision=cp,
+                              path="direct")
+            dl = jnp.matmul(As.local, Bs.local, precision=precision)
+            D = DistMatrix(dl, (m, n), VC, STAR, 0, 0, g)
+        else:
+            As = redistribute(A, STAR, STAR, comm_precision=cp,
+                              path="direct")
+            Bs = redistribute(B, STAR, VR, comm_precision=cp, path="direct")
+            dl = jnp.matmul(As.local, Bs.local, precision=precision)
+            D = DistMatrix(dl, (m, n), STAR, VR, 0, 0, g)
+        d = redistribute(D, MC, MR, path="direct").local
     res = C.with_local(_safe_astype(
         alpha * d + (beta * C.local if _nonzero(beta) else 0),
         C.dtype))

@@ -460,3 +460,85 @@ def compile_plan(src: tuple, dst: tuple, gshape: tuple,
         recv_rows=recv_rows, recv_cols=recv_cols,
         src_local=(src_lr, src_lc), dst_local=(dst_lr, dst_lc),
         groups=a2a_groups)
+
+
+# ---------------------------------------------------------------------
+# Slice-set compilation (ISSUE 16 -- the slicing-gemm schedule)
+# ---------------------------------------------------------------------
+
+def slice_row_mode(m: int, n: int, grid_shape: tuple) -> bool:
+    """Which output dimension the slicing gemm slices 1-D cyclic.
+
+    Row slices ([VC,STAR] output) when the output is tall (``m >= n``)
+    or the grid is Nx1 (where [MC,MR] <-> [VC,STAR] is a pure local
+    relabeling, leaving the B broadcast as the ONLY collective); column
+    slices ([STAR,VR]) otherwise -- symmetrically free on 1xN grids.
+    One rule shared by the executor (``blas.level3._summa_slice``), the
+    cost model and the analysis drivers, so the tuner prices exactly the
+    plans the executor runs."""
+    r, c = grid_shape
+    return c == 1 or (r != 1 and m >= n)
+
+
+def compile_slice_plan(src: tuple, dst: tuple, gshape: tuple,
+                       grid_shape: tuple, rows: tuple | None = None,
+                       cols: tuple | None = None,
+                       src_align: tuple = (0, 0),
+                       dst_align: tuple = (0, 0)):
+    """Compile ``src -> dst`` for a contiguous SUB-RANGE of the operand.
+
+    ``rows=(r0, r1)`` / ``cols=(c0, c1)`` select the half-open global
+    slice ``A[r0:r1, c0:c1]`` (defaults: the full extent).  The view
+    identity makes this exact, not approximate: the device owning global
+    index ``g`` of a matrix aligned at ``a`` is the zero-aligned owner of
+    ``g + a``, so a sub-range starting at ``r0`` is itself a distributed
+    matrix of shape ``(r1-r0, c1-c0)`` aligned at
+    ``(align + offset) mod stride`` -- and the full ``compile_plan``
+    machinery (ragged trimming, FFD a2a packing, CRT intersections)
+    applies unchanged.  This is how per-block operand slices of the
+    slicing gemm (and any future blocked one-shot consumer) compile
+    without a full-matrix-endpoint detour.  lru-cached via
+    ``compile_plan``; returns None for a no-op exactly as it does."""
+    m, n = gshape
+    r0, r1 = (0, m) if rows is None else rows
+    c0, c1 = (0, n) if cols is None else cols
+    if not (0 <= r0 <= r1 <= m and 0 <= c0 <= c1 <= n):
+        raise ValueError(f"slice rows={rows} cols={cols} outside {gshape}")
+    r, c = grid_shape
+    sa = ((src_align[0] + r0) % dist_stride(src[0], r, c),
+          (src_align[1] + c0) % dist_stride(src[1], r, c))
+    da = ((dst_align[0] + r0) % dist_stride(dst[0], r, c),
+          (dst_align[1] + c0) % dist_stride(dst[1], r, c))
+    return compile_plan(tuple(src), tuple(dst), (r1 - r0, c1 - c0),
+                        (r, c), sa, da)
+
+
+def gemm_slice_plans(m: int, k: int, n: int, grid_shape: tuple):
+    """The compiled plan set of the slicing gemm at one geometry.
+
+    Returns ``(mode, plans)`` where mode is ``'local'`` (1x1: zero
+    collectives), ``'rows'`` or ``'cols'``, and plans is a tuple of
+    ``(tag, RedistPlan)`` -- the pure-relabeling degenerate legs (Nx1 /
+    1xN grids) come back as zero-round ``kind='local'`` plans.  Single
+    source of truth for the cost model's closed-form slot-byte pricing
+    and the analysis pins."""
+    r, c = grid_shape
+    if r * c == 1:
+        return "local", ()
+    if slice_row_mode(m, n, grid_shape):
+        return "rows", (
+            ("A->[VC,*]", compile_plan((MC, MR), (VC, STAR), (m, k),
+                                       grid_shape)),
+            ("B->[*,*]", compile_plan((MC, MR), (STAR, STAR), (k, n),
+                                      grid_shape)),
+            ("D->[MC,MR]", compile_plan((VC, STAR), (MC, MR), (m, n),
+                                        grid_shape)),
+        )
+    return "cols", (
+        ("A->[*,*]", compile_plan((MC, MR), (STAR, STAR), (m, k),
+                                  grid_shape)),
+        ("B->[*,VR]", compile_plan((MC, MR), (STAR, VR), (k, n),
+                                   grid_shape)),
+        ("D->[MC,MR]", compile_plan((STAR, VR), (MC, MR), (m, n),
+                                    grid_shape)),
+    )

@@ -450,6 +450,20 @@ def _gemm_sites(alg: str, m: int, k: int, n: int, r: int, c: int,
         ag("B->[MR,*]", (k / c) * (n / r), r)
         ps("D psum(mr)", (m / r) * n, c)
         ag("D->[MC,MR]", (m / r) * (n / c), 1 if c == 1 else 2)
+    elif alg == "slice":
+        # Slicing gemm (ISSUE 16): three one-shot plans, priced off the
+        # SAME compiled RedistPlan byte math the executor runs --
+        # regardless of redist_path (the slice gathers ARE direct plans,
+        # so the knob crossing prices identically and the tie-break
+        # keeps the default).  No hidden psum: k is unsharded on both
+        # sides of the local contraction.
+        if p > 1:
+            from ..redist.plan import gemm_slice_plans
+            for tag, plan in gemm_slice_plans(m, k, n, (r, c))[1]:
+                if plan is None or plan.kind == "local":
+                    continue                # degenerate relabeling leg
+                prim = "all_to_all" if plan.kind == "a2a" else "ppermute"
+                sites.append((tag, prim, plan.wire_bytes(z)))
     else:
         raise KeyError(f"unknown gemm alg {alg!r}")
     rounds = len(sites)

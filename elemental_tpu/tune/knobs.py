@@ -202,8 +202,11 @@ def _nb_comm_space(ctx: TuneContext, pinned: dict) -> list:
 
 #: gemm candidate order doubles as the deterministic tie-break: on a 1x1
 #: grid every alg has zero comm cost and 'dot' early-outs to ONE local
-#: matmul (the pinned ``_summa_dot`` p==1 fast path), so it leads.
-GEMM_ALGS = ("dot", "C", "A", "B", "gspmd")
+#: matmul (the pinned ``_summa_dot`` p==1 fast path), so it leads;
+#: 'slice' (ISSUE 16) appends LAST so every pre-existing exact tie keeps
+#: its historical winner and 'slice' only takes geometries it strictly
+#: wins (tall-skinny / non-square grids).
+GEMM_ALGS = ("dot", "C", "A", "B", "gspmd", "slice")
 
 
 def _gemm_space(ctx: TuneContext, pinned: dict) -> list:
@@ -215,9 +218,17 @@ def _gemm_space(ctx: TuneContext, pinned: dict) -> list:
         if alg == "dot" and ctx.grid_size > 1 and m * n > DOT_ELEMENT_CAP \
                 and "alg" not in pinned:
             continue                      # replicated-C memory guard
+        if alg == "slice" and ctx.grid_size > 1 and "alg" not in pinned:
+            # replicated-operand memory guard: the mode rule broadcasts
+            # the small operand ([STAR,STAR]); skip when even that is
+            # too large to replicate per device.
+            from ..redist.plan import slice_row_mode
+            repl = k * n if slice_row_mode(m, n, ctx.grid_shape) else m * k
+            if repl > DOT_ELEMENT_CAP:
+                continue
         for nb in nbs:
             out.append({"alg": alg, "nb": nb})
-            if alg in ("dot", "gspmd"):
+            if alg in ("dot", "gspmd", "slice"):
                 break                     # nb is dead for the one-shot algs
     return _with_redist_path(_with_comm_precision(out, ctx, pinned), ctx,
                              pinned)

@@ -15,6 +15,14 @@ before/after pair).  Usage:
                                             #   look-ahead x tail crossover
                                             #   x comm_precision wire sweep
                                             #   on ALL visible devices
+    python perf/ab_harness.py gemm [N]      # ISSUE 16: the full gemm alg
+                                            #   family (A/B/C/dot/gspmd/
+                                            #   slice/auto) x shape class
+                                            #   (square / tall-skinny m>>n /
+                                            #   outer-product k-small) on
+                                            #   ALL visible devices, plus
+                                            #   comm_precision twins of the
+                                            #   slice rows
     python perf/ab_harness.py phases [lu|cholesky] [N NB]
                                             # per-step phase wall-clock as
                                             #   one phase_timings/v1 JSON line
@@ -84,7 +92,10 @@ def roofline():
         t = jnp.zeros(())
         float(tiny(t))
         LAT = _min3(lambda: float(tiny(t)))
-    n = 8192
+    # CPU smoke runs: the fixed probe would dominate the sweep (minutes
+    # per bracket at HIGHEST precision); the weather-tracking bracket only
+    # needs a consistent in-run yardstick, not the TPU-saturating size
+    n = 8192 if jax.devices()[0].platform != "cpu" else 512
     if _ROOF_R is None:
         _ROOF_R = jax.random.normal(jax.random.PRNGKey(9), (n, n), jnp.float32)
     mm = jax.jit(lambda x: jnp.matmul(x, x, precision=HI))
@@ -362,6 +373,57 @@ def run_cholesky(n=None, cps=("bf16", "int8")):
         del step
 
 
+def run_gemm(n=None, cps=("bf16", "int8")):
+    """ISSUE 16 A/B: the full gemm alg family x shape class, same
+    process and grid (all visible devices), roofline-bracketed.
+
+    Three shape classes cover the regimes the alg space splits on:
+    ``square`` (the SUMMA home turf), ``tall-skinny`` (m >> n -- where
+    the slicing schedule's three one-shot plans beat the panel rings;
+    the bench.py ``gemm_tall_skinny_tflops_per_chip`` headline class)
+    and ``outer-product`` (k small).  The ``auto`` row shows what the
+    tuner dispatches per class, and the slice rows get comm_precision
+    wire twins (equal shape/grid, pure wire-precision A/B).  Rows whose
+    schedule cannot run the shape (e.g. dot's replicated-C blowup on
+    huge squares) report ``skip`` instead of aborting the sweep."""
+    on_tpu = jax.devices()[0].platform != "cpu"
+    n = int(n) if n else (8192 if on_tpu else 256)
+    grid = el.Grid(jax.devices())
+    shapes = [("square", (n, n, n)),
+              ("tall-skinny", (16 * n, n, max(n // 4, 1))),
+              ("outer-product", (n, max(n // 16, 1), n))]
+    algs = ["C", "A", "B", "dot", "gspmd", "slice", "auto"]
+    print(f"grid {grid.height}x{grid.width}", flush=True)
+    for cls, (m, k, nn) in shapes:
+        print(f"-- {cls}: m={m} k={k} n={nn}", flush=True)
+        gen = jax.jit(lambda _m=m, _k=k, _n=nn: (
+            jax.random.normal(jax.random.PRNGKey(2), (_m, _k), jnp.float32),
+            jax.random.normal(jax.random.PRNGKey(3), (_k, _n), jnp.float32)))
+
+        def wrap(ab, _m=m, _k=k, _n=nn):
+            a, b = ab
+            return (el.from_global(a, el.MC, el.MR, grid=grid),
+                    el.from_global(b, el.MC, el.MR, grid=grid))
+
+        rows = [(a, None) for a in algs] + [("slice", cp) for cp in cps]
+        for alg, cp in rows:
+            name = f"{cls:13s} alg={alg}" + (f" wire={cp}" if cp else "")
+            try:
+                step = jax.jit(
+                    lambda ab, _a=alg, _c=cp: el.gemm(
+                        ab[0], ab[1], alg=_a, precision=HI,
+                        comm_precision=_c).local,
+                    donate_argnums=0)
+                r0 = roofline()
+                dt = timed(lambda: wrap(gen()), step)
+                r1 = roofline()
+                report(name, 2 * m * k * nn / dt / 1e12, 0.5 * (r0 + r1))
+                del step
+            except Exception as e:                     # noqa: BLE001
+                print(f"{name:44s} skip ({type(e).__name__}: {e})",
+                      flush=True)
+
+
 def run_phases(*args):
     """Per-step phase wall-clock through the REAL driver (eager, PhaseTimer
     syncs at each boundary) -> one phase_timings/v1 JSON line.
@@ -424,5 +486,7 @@ if __name__ == "__main__":
         run_lu_dist(*argv[1:2], cps=cps)
     elif mode == "cholesky":
         run_cholesky(*argv[1:2], cps=cps)
+    elif mode == "gemm":
+        run_gemm(*argv[1:2], cps=cps)
     else:
         run_phases(*argv[1:4])

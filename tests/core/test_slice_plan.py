@@ -1,0 +1,105 @@
+"""ISSUE 16: slice-set compilation (``compile_slice_plan``) + the ragged
+FFD slot-packing edge cases the slicing gemm newly exercises."""
+import numpy as np
+import pytest
+
+from elemental_tpu.core.dist import MC, MR, VC, STAR
+from elemental_tpu.redist.plan import (compile_plan, compile_slice_plan,
+                                       gemm_slice_plans, slice_row_mode)
+
+
+def _same_plan(a, b):
+    assert a.kind == b.kind and a.gshape == b.gshape
+    assert a.slot_shape == b.slot_shape and a.comm_axes == b.comm_axes
+    assert a.groups == b.groups
+    for f in ("send_rows", "send_cols", "recv_rows", "recv_cols"):
+        assert np.array_equal(getattr(a, f), getattr(b, f)), f
+
+
+def test_subrange_is_shifted_alignment():
+    """A contiguous sub-range compiles to EXACTLY the plan of the
+    trimmed matrix at the offset-shifted alignments (the view identity:
+    owner of global g at align a == zero-aligned owner of g + a)."""
+    got = compile_slice_plan((MC, MR), (VC, STAR), (64, 64), (2, 2),
+                             rows=(17, 49))
+    want = compile_plan((MC, MR), (VC, STAR), (32, 64), (2, 2),
+                        (17 % 2, 0), (17 % 4, 0))
+    _same_plan(got, want)
+    assert got.gshape == (32, 64)
+    # column sub-range shifts the column alignment under the col stride
+    got2 = compile_slice_plan((MC, MR), (STAR, MR), (32, 48), (2, 4),
+                              cols=(5, 21))
+    want2 = compile_plan((MC, MR), (STAR, MR), (32, 16), (2, 4),
+                         (0, 5 % 4), (0, 5 % 4))
+    _same_plan(got2, want2)
+
+
+def test_full_range_defaults_equal_compile_plan():
+    got = compile_slice_plan((MC, MR), (STAR, STAR), (24, 40), (2, 2))
+    want = compile_plan((MC, MR), (STAR, STAR), (24, 40), (2, 2),
+                        (0, 0), (0, 0))
+    _same_plan(got, want)
+
+
+def test_out_of_range_raises():
+    with pytest.raises(ValueError):
+        compile_slice_plan((MC, MR), (VC, STAR), (64, 64), (2, 2),
+                           rows=(8, 80))
+    with pytest.raises(ValueError):
+        compile_slice_plan((MC, MR), (VC, STAR), (64, 64), (2, 2),
+                           cols=(-1, 8))
+    with pytest.raises(ValueError):
+        compile_slice_plan((MC, MR), (VC, STAR), (64, 64), (2, 2),
+                           rows=(40, 8))
+
+
+def test_empty_slot_device_ships_sentinel_only():
+    """m < p under [VC,STAR]: the tail devices own ZERO rows of the
+    destination -- their recv tables are pure sentinel padding (sentinel
+    == the local extent) and the plan still compiles/prices honestly."""
+    plan = compile_plan((MC, MR), (VC, STAR), (3, 8), (2, 2))
+    assert plan.kind == "a2a"
+    R = plan.recv_rows.shape[-1]
+    sent_r = plan.dst_local[0]
+    empty = [d for d in range(4)
+             if (plan.recv_rows[d] >= sent_r).all()]
+    assert empty == [3]                    # VC owner of rows 0,1,2 = devs 0-2
+    assert plan.wire_bytes(4) > 0          # padded slots still ship
+
+
+def test_single_bin_degenerate_pack():
+    """A full-bipartite traffic graph (every device needs every sender:
+    the [STAR,STAR] broadcast) cannot FFD-decompose: one bin, no
+    axis_index_groups, slot count == the full comm size."""
+    plan = compile_plan((MC, MR), (STAR, STAR), (64, 16), (2, 4))
+    assert plan.kind == "a2a"
+    assert plan.groups == ()               # single-bin degenerate pack
+    assert plan.nslots == 8
+
+
+def test_ragged_trailing_trim():
+    """Ragged extents trim the trailing all-sentinel tail: the slot of a
+    (5, 3) slice over 4 VC ranks is ceil(5/4) x 3, not the padded
+    storage extent."""
+    plan = compile_plan((MC, MR), (VC, STAR), (5, 3), (2, 2))
+    assert plan.slot_shape[0] <= 2 and plan.slot_shape[1] <= 3
+
+
+@pytest.mark.parametrize("grid_shape,mode,collectives",
+                         [((1, 1), "local", 0), ((2, 2), "rows", 3),
+                          ((2, 4), "rows", 3), ((4, 1), "rows", 1),
+                          ((1, 4), "cols", 1)])
+def test_gemm_slice_plan_set(grid_shape, mode, collectives):
+    """The plan-set helper: mode rule + collective count per grid class
+    (1x1 zero plans; Nx1/1xN exactly one collective; 2-D grids three)."""
+    got_mode, plans = gemm_slice_plans(2048, 64, 16, grid_shape)
+    assert got_mode == mode
+    assert sum(p.rounds for _, p in plans if p is not None) == collectives
+
+
+def test_slice_row_mode_rule():
+    assert slice_row_mode(2048, 16, (2, 2))      # tall: rows
+    assert not slice_row_mode(16, 2048, (2, 2))  # wide: cols
+    assert slice_row_mode(16, 2048, (4, 1))      # Nx1 forces rows
+    assert not slice_row_mode(2048, 16, (1, 4))  # 1xN forces cols
+    assert slice_row_mode(64, 64, (2, 2))        # square ties to rows

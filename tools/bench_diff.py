@@ -15,7 +15,10 @@ makes that trajectory a GATE instead of an archive::
         # the wrapped form or a raw bench.py output line)
 
 Gated metrics default to the ROOFLINE-NORMALIZED ratios ``vs_baseline``
-(cholesky) and ``lu_vs_baseline`` -- raw TFLOP/s on shared/tunneled chips
+(cholesky), ``lu_vs_baseline`` and ``gemm_vs_baseline`` (the ISSUE-16
+tall-skinny GEMM headline, whose named value
+``gemm_tall_skinny_tflops_per_chip`` is gated on the same wide band as
+the LU TFLOP/s) -- raw TFLOP/s on shared/tunneled chips
 swings ~2x run to run (see bench.py), while the in-run-roofline ratio
 isolates algorithmic regressions from chip weather.  Override with one
 or more ``--metric NAME`` (e.g. ``--metric value`` for raw cholesky
@@ -64,6 +67,8 @@ import sys
 
 DEFAULT_METRICS = ("vs_baseline", "lu_vs_baseline",
                    "lu_n32768_tflops_per_chip",
+                   "gemm_vs_baseline",
+                   "gemm_tall_skinny_tflops_per_chip",
                    "serve_p99_ms", "serve_solves_per_sec",
                    "serve_async_p99_ms", "serve_async_solves_per_sec",
                    "redist_p2p_gbps")
@@ -75,6 +80,7 @@ DEFAULT_THRESHOLD = 0.10
 #: roofline-normalized default ratios; serving wall-clock metrics swing
 #: with host weather and get the same wide band.
 DEFAULT_PER_METRIC = {"lu_n32768_tflops_per_chip": 0.25,
+                      "gemm_tall_skinny_tflops_per_chip": 0.25,
                       "serve_p99_ms": 0.25,
                       "serve_solves_per_sec": 0.25,
                       "serve_async_p99_ms": 0.25,
@@ -102,7 +108,7 @@ def load_doc(path: str) -> dict:
         doc = doc["parsed"]
     if not isinstance(doc, dict):
         raise ValueError(f"{path}: not a JSON object")
-    for prefix in ("", "lu_"):
+    for prefix in ("", "lu_", "gemm_"):
         name, val = doc.get(prefix + "metric"), doc.get(prefix + "value")
         if isinstance(name, str) and isinstance(val, (int, float)) \
                 and name not in doc:

@@ -38,6 +38,13 @@
 #                             #   panel granularity, recompute count == 1)
 #                             #   + the *_abft comm-plan golden diff +
 #                             #   tests/resilience/test_abft.py
+#   tools/check.sh gemm       # slicing-gemm gate (ISSUE 16): the
+#                             #   gemm_slice comm-plan goldens (1x1 +
+#                             #   2x2), the comm_audit gemm-prefix
+#                             #   lint/diff coverage, the tuner-selection
+#                             #   pins (auto->slice on tall-skinny 2x4,
+#                             #   auto->dot on 1x1), and the slice
+#                             #   correctness/plan/knob test files
 #   tools/check.sh redist     # one-shot redistribution gate (ISSUE 12 +
 #                             #   13): plan-compiler unit + direct-vs-
 #                             #   chain bit-equivalence tests (incl.
@@ -209,6 +216,57 @@ PY
     echo "== redist_bench smoke (1x1, chain-vs-direct bit-match) =="
     JAX_PLATFORMS=cpu python -m perf.redist_bench --smoke --reps 1 \
         > /dev/null || rc=1
+fi
+
+if [ "$what" = "all" ] || [ "$what" = "gemm" ]; then
+    echo "== gemm_slice comm-plan goldens (1x1 + 2x2) =="
+    JAX_PLATFORMS=cpu python -m perf.comm_audit diff gemm_slice || rc=1
+    echo "== comm_audit gemm-prefix coverage (lint + diff over all gemm variants) =="
+    JAX_PLATFORMS=cpu python -m perf.comm_audit lint gemm || rc=1
+    JAX_PLATFORMS=cpu python -m perf.comm_audit diff gemm || rc=1
+    echo "== tuner-selection pins (auto->slice tall-skinny 2x4, auto->dot 1x1) =="
+    # resolve on the comm_audit virtual-device mesh: slice must win the
+    # tall-skinny geometry on a 2x4 grid and the pinned dot early-out
+    # must keep the 1x1 tie-break (slice joining the space is additive)
+    python - <<'PY' || rc=1
+import os, sys
+sys.path.insert(0, os.getcwd())
+from perf.comm_audit import _bootstrap
+_bootstrap()
+import jax
+import jax.numpy as jnp
+import elemental_tpu as el
+from elemental_tpu import tune
+
+def pick(gshape, r, c):
+    grid = el.Grid(jax.devices()[: r * c], height=r)
+    kn = tune.resolve_knobs("gemm", gshape=gshape, dtype=jnp.float32,
+                            grid=grid,
+                            knobs={"alg": "auto", "nb": None,
+                                   "comm_precision": None,
+                                   "redist_path": None})
+    return kn["alg"]
+
+bad = []
+got = pick((8192, 512, 256), 2, 4)
+if got != "slice":
+    bad.append(f"tall-skinny 2x4: auto -> {got!r}, want 'slice'")
+got = pick((8192, 512, 256), 1, 1)
+if got != "dot":
+    bad.append(f"1x1: auto -> {got!r}, want 'dot'")
+if bad:
+    print("TUNER-SELECTION PIN FAILURE:")
+    for b in bad:
+        print(f"  {b}")
+    sys.exit(1)
+print("tuner-selection pins ok (auto->slice 2x4 tall-skinny, auto->dot 1x1)")
+PY
+    echo "== slicing-gemm tier-1 tests (correctness + plans + knob) =="
+    python -m pytest tests/blas/test_level3_slice.py \
+        tests/core/test_slice_plan.py \
+        tests/analysis/test_gemm_slice_plan.py \
+        tests/tune/test_gemm_slice_knob.py \
+        -q -m 'not slow' -p no:cacheprovider || rc=1
 fi
 
 if [ "$what" = "all" ] || [ "$what" = "serve" ]; then
