@@ -271,10 +271,20 @@ def main():
     # bench would record 'calu' here -- the provenance the trajectory
     # gate reads next to the renamed lu_n32768 metric.  (The timed runs
     # above use the pinned nb/panel for baseline comparability.)
+    # panel_impl + inners join ran_with (ISSUE 17): the timed runs above
+    # execute the status-quo XLA panel ladder at the pinned chunk widths
+    # (read from kernels.default_inners(), the single source -- NOT the
+    # lu module alias, which a tuner/harness override would leave stale),
+    # and the per-op resolutions below record which implementation
+    # 'auto' would dispatch on THIS backend (pallas on TPU, xla
+    # elsewhere -- the interpret-penalty term of the cost model).
+    from elemental_tpu.kernels import default_inners
     tuner: dict = {"ran_with": {"nb": nb, "lookahead": True,
                                 "crossover": None, "panel": "classic",
                                 "comm_precision": None,
-                                "redist_path": None}}
+                                "redist_path": None,
+                                "panel_impl": None,
+                                "inners": list(default_inners())}}
     try:
         from elemental_tpu import tune as el_tune
         for op, gshape in (("cholesky", (n_chol, n_chol)),
@@ -300,7 +310,7 @@ def main():
             else:
                 requested = {"nb": "auto", "lookahead": "auto",
                              "crossover": "auto", "comm_precision": "auto",
-                             "redist_path": "auto"}
+                             "redist_path": "auto", "panel_impl": "auto"}
                 if op == "lu":
                     requested["panel"] = "auto"
             res = el_tune.resolve(

@@ -1,0 +1,130 @@
+"""Fused Pallas panel kernels for the factorization critical path
+(ISSUE 17).
+
+Panel factorization is the serial spine of every blocked schedule: the
+LU chunk ladder, the Cholesky diagonal-block factor/inverse pair, and
+the QR larfg chain each lower to dozens of small XLA ops whose launch
+and layout overhead dominates at small nb.  This package fuses each
+primitive into one ``pallas_call`` that keeps the replicated panel
+resident in VMEM:
+
+* :func:`lu_panel` -- pivot search + column scale + rank-1/chunked
+  trailing updates, bit-twin of ``lapack.lu._panel_lu`` (pivot sequence
+  identical in unblocked mode);
+* :func:`potrf_inv` -- blocked potrf + triangular inverse, twin of
+  ``lapack.cholesky._potrf_inv_impl`` (residual-bounded);
+* :func:`qr_panel` -- larfg reflector chain + larft T build, twin of
+  ``lapack.qr._panel_qr`` + ``_larft`` (residual-bounded).
+
+Selection is driven by the ``panel_impl='xla'|'pallas'|'auto'`` knob on
+``lu`` / ``cholesky`` / ``qr``: :func:`resolve_panel` turns the
+resolved knob into a :class:`PanelPlan`, and each call site asks
+``plan.use_pallas(shape, dtype)`` -- a STATIC trace-time gate that
+falls back to the XLA twin for complex dtypes and for panels whose
+working set exceeds the VMEM budget, so the fused kernels never
+silently spill.  Off-TPU the kernels run under
+``pl.pallas_call(interpret=True)``, which is how CPU CI pins the twins
+(see ``tests/kernels/``).
+
+Panels are replicated-local compute: a ``pallas_call`` is a local
+primitive with no collectives, so every comm-plan golden is byte-
+identical under either implementation (gated by ``tools/check.sh
+kernels``).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from .common import (LANE, PANEL_VMEM_BUDGET, SUBLANE, interpret_default,
+                     pad_square, pad_tiles, panel_fits, round_up)
+from .lu_panel import lu_panel
+from .chol_panel import potrf_inv
+from .qr_panel import qr_panel
+
+#: implementations the ``panel_impl`` knob enumerates ('auto' resolves
+#: to one of these); 'xla' first, so ties in the tuner's cost ranking
+#: keep the status-quo path (same convention as tune.knobs.LU_PANELS).
+PANEL_IMPLS = ("xla", "pallas")
+
+#: LU chunk ladder, pinned from a v5e A/B sweep (perf/ab_harness.py lu,
+#: BENCH_r05: 512/64 beat 256/64 and 512/128 by 4-7%% at N=16384).
+#: Single source of truth -- lapack.lu, the A/B harness, and bench
+#: provenance all read it through default_inners() / resolve_panel()
+#: rather than importing a bare module constant that monkeypatching
+#: would silently go stale on (the ISSUE 17 staleness footgun).
+DEFAULT_INNERS = (512, 64)
+
+
+def default_inners() -> tuple:
+    """The pinned LU panel chunk ladder (see :data:`DEFAULT_INNERS`)."""
+    return DEFAULT_INNERS
+
+
+@dataclass(frozen=True)
+class PanelPlan:
+    """Resolved panel-implementation choice plus its provenance.
+
+    ``impl`` is the post-'auto' knob value; ``inners`` is the LU chunk
+    ladder the XLA path recurses on AND the width the fused kernel's
+    blocked mode uses (``pallas_inner``); ``source`` records where the
+    choice came from ('default', 'explicit', 'tuned', 'complex-xla')
+    so bench provenance can attribute a headline move to the knob.
+    """
+
+    impl: str = "xla"
+    inners: tuple = DEFAULT_INNERS
+    source: str = "default"
+
+    def use_pallas(self, shape, dtype, copies: int = 3) -> bool:
+        """Static per-call-site gate: fused kernel only for real dtypes
+        whose padded working set (``copies`` VMEM residents) fits the
+        budget; everything else stays on the XLA twin."""
+        if self.impl != "pallas":
+            return False
+        if jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating):
+            return False
+        return panel_fits(shape, dtype, copies=copies)
+
+    @property
+    def pallas_inner(self) -> int:
+        """Chunk width for the fused LU kernel's blocked mode: the
+        finest rung of the ladder (coarser rungs exist to amortize XLA
+        launches, which the fused kernel has already paid once)."""
+        return int(self.inners[-1]) if self.inners else 0
+
+    def to_doc(self) -> dict:
+        return {"impl": self.impl, "inners": list(self.inners),
+                "source": self.source}
+
+
+def resolve_panel(panel_impl=None, *, dtype=None, inners=None,
+                  source: str | None = None) -> PanelPlan:
+    """Turn a resolved ``panel_impl`` knob value into a
+    :class:`PanelPlan`.
+
+    ``None`` means the status-quo XLA path ('auto' is resolved by
+    ``tune.resolve_knobs`` BEFORE this point -- drivers never pass it
+    here).  Complex dtypes fall back to 'xla' silently by design: the
+    knob is a performance hint and the XLA twin is the same math, so a
+    complex matrix through ``panel_impl='pallas'`` must factor, not
+    raise (pinned by tests/kernels/test_dispatch.py).
+    """
+    impl = "xla" if panel_impl is None else str(panel_impl)
+    if impl == "auto":
+        # defensive: an unresolved 'auto' (e.g. tuner disabled) keeps
+        # the status-quo path rather than guessing at the backend here
+        impl = "xla"
+    if impl not in PANEL_IMPLS:
+        raise ValueError(
+            f"panel_impl must be one of {PANEL_IMPLS + ('auto',)}, "
+            f"got {panel_impl!r}")
+    src = source if source is not None else (
+        "default" if panel_impl is None else "explicit")
+    if (impl == "pallas" and dtype is not None
+            and jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating)):
+        impl, src = "xla", "complex-xla"
+    lad = default_inners() if inners is None else tuple(
+        int(i) for i in inners)
+    return PanelPlan(impl=impl, inners=lad, source=src)

@@ -1,0 +1,142 @@
+"""Fused Pallas ``_potrf_inv``: blocked lower Cholesky of a diagonal
+block AND its triangular inverse in one kernel launch.
+
+The XLA path (``lapack.cholesky._potrf_inv_impl``) already restructures
+the work into ``bs``-sized diagonal potrfs plus matmul assembly, but it
+still pays one ``cholesky`` + one ``triangular_solve`` launch per block
+-- latency-bound inner loops on the factorization spine.  Here the
+whole (w, w) block lives in VMEM: the per-block potrf is an in-kernel
+column recurrence, the per-block inverse is an in-kernel forward
+substitution, and the inverse assembly / trailing updates are the same
+MXU dots the reference issues -- all inside one ``pallas_call``.
+
+The block recurrences are written with masked row/column extraction
+(``where``-sums over exact zeros) instead of gathers: everything stays
+(b, b)-shaped and Mosaic-friendly.  The math matches the reference
+block-for-block but the scalar recurrences round differently from
+XLA's native potrf/trsm, so the twin contract is residual-bounded
+(``L L^H ~ A``, ``Li L ~ I``), not bit-pinned -- see
+``tests/kernels/test_chol_panel.py`` for the documented bounds.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from .common import interpret_default, pad_square
+
+_HI = lax.Precision.HIGHEST
+
+
+def _chol_unb(B):
+    """Unblocked lower Cholesky of a (b, b) symmetrized block: column
+    recurrence with masked extraction, valid in the lower triangle."""
+    b = B.shape[0]
+    dt = B.dtype
+    ri = lax.broadcasted_iota(jnp.int32, (b, b), 0)
+    ci = lax.broadcasted_iota(jnp.int32, (b, b), 1)
+    rcol = ri[:, :1]
+
+    def body(j, A):
+        # columns < j hold finished L columns; the lower triangle of
+        # columns >= j holds the running Schur complement
+        piv = jnp.sum(jnp.where((ri == j) & (ci == j), A, 0))
+        dj = jnp.sqrt(piv)
+        colj = jnp.sum(jnp.where(ci == j, A, 0), axis=1, keepdims=True)
+        lcol = jnp.where(rcol > j, colj / dj, jnp.zeros_like(colj))
+        lcol = jnp.where(rcol == j, dj.astype(dt), lcol)
+        outer = lcol * jnp.swapaxes(jnp.conj(lcol), 0, 1)
+        A = A - jnp.where((ci > j) & (ri >= ci), outer, 0)
+        return jnp.where(ci == j, lcol, A)
+
+    return jnp.tril(lax.fori_loop(0, b, body, B))
+
+
+def _trinv_unb(L):
+    """Forward-substitution inverse of a (b, b) lower-triangular block:
+    row i of L^{-1} from rows < i, one masked (1, b) x (b, b) dot per
+    step."""
+    b = L.shape[0]
+    dt = L.dtype
+    ri = lax.broadcasted_iota(jnp.int32, (b, b), 0)
+    ci = lax.broadcasted_iota(jnp.int32, (b, b), 1)
+    crow = ci[:1, :]
+    one = jnp.ones((), dt)
+
+    def body(i, X):
+        lrow = jnp.sum(jnp.where(ri == i, L, 0), axis=0, keepdims=True)
+        dii = jnp.sum(jnp.where(crow == i, lrow, 0))
+        lstrict = jnp.where(crow < i, lrow, jnp.zeros_like(lrow))
+        corr = jnp.dot(lstrict, X, precision=_HI)
+        erow = jnp.where(crow == i, one, jnp.zeros_like(lrow))
+        newrow = (erow - corr) / dii
+        return jnp.where(ri == i, newrow, X)
+
+    return lax.fori_loop(0, b, body, jnp.zeros((b, b), dt))
+
+
+def _potrf_inv_kernel(d_ref, l_ref, li_ref, *, w, bs, precision):
+    D = d_ref[...]
+    dt = D.dtype
+    # symmetrize from the lower triangle, as the reference does (the
+    # padded border is zero and stays zero)
+    d = jnp.tril(D)
+    d = d + jnp.conj(jnp.tril(d, -1)).T
+    L = jnp.zeros_like(d)
+    Li = jnp.zeros_like(d)
+    T = d
+    # block writes go through dynamic_update_slice (static starts): the
+    # .at[].set scatter path constant-folds its index arrays, and when a
+    # slice covers the whole (unpadded) block those fold to EMPTY int32
+    # constants the kernel would illegally capture
+    for s in range(0, w, bs):
+        e = min(s + bs, w)
+        dkk = T[s:e, s:e]
+        dkk = jnp.tril(dkk) + jnp.conj(jnp.tril(dkk, -1)).T
+        Lkk = _chol_unb(dkk)
+        Likk = _trinv_unb(Lkk)
+        L = lax.dynamic_update_slice(L, Lkk, (s, s))
+        # inverse assembly: Li[s:e, :s] = -Likk @ L[s:e, :s] @ Li[:s, :s]
+        if s > 0:
+            corr = jnp.dot(
+                Likk, jnp.dot(L[s:e, :s], Li[:s, :s], precision=precision),
+                precision=precision)
+            Li = lax.dynamic_update_slice(Li, -corr.astype(dt), (s, 0))
+        Li = lax.dynamic_update_slice(Li, Likk, (s, s))
+        if e < w:
+            B21 = jnp.dot(T[e:w, s:e], jnp.conj(Likk).T,
+                          precision=precision).astype(dt)
+            L = lax.dynamic_update_slice(L, B21, (e, s))
+            T = lax.dynamic_update_slice(
+                T, T[e:w, e:w] - jnp.dot(B21, jnp.conj(B21).T,
+                                         precision=precision).astype(dt),
+                (e, e))
+    l_ref[...] = L
+    li_ref[...] = Li
+
+
+def potrf_inv(D, precision=None, *, bs: int = 512, interpret=None):
+    """Fused twin of ``lapack.cholesky._potrf_inv_impl``: one launch,
+    same contract ``(L, L^{-1})`` from a (w, w) Hermitian block (lower
+    triangle valid).  Real dtypes only -- complex panels are gated back
+    to the XLA path by the ``panel_impl`` dispatch."""
+    w = D.shape[0]
+    if jnp.issubdtype(D.dtype, jnp.complexfloating):
+        raise ValueError("pallas potrf_inv is real-only; the panel_impl "
+                         "dispatch falls back to xla for complex dtypes")
+    # factor-forming dots run at full accumulation, matching lu._hi
+    precision = _HI if precision is None else precision
+    Dp = pad_square(D)
+    kern = functools.partial(_potrf_inv_kernel, w=w, bs=int(bs),
+                             precision=precision)
+    shp = jax.ShapeDtypeStruct(Dp.shape, D.dtype)
+    L, Li = pl.pallas_call(
+        kern,
+        out_shape=(shp, shp),
+        interpret=interpret_default(interpret),
+    )(Dp)
+    return L[:w, :w], Li[:w, :w]

@@ -1,0 +1,81 @@
+"""Shared plumbing for the fused Pallas panel kernels (ISSUE 17).
+
+Panel factorization is replicated-local compute: every rank holds the
+whole [STAR,STAR] panel and runs the same serial column recurrence, so
+the fusion problem is purely single-chip -- keep the panel resident in
+VMEM, run the recurrence as one kernel body, and emit the packed factor
+in a single store.  This module holds what all three kernels share:
+
+* tile-aligned padding: float32 VMEM tiles are (sublane, lane) =
+  (8, 128), so inputs are padded up to tile multiples and the column
+  recurrences run only over the real extent -- the padding is zeros
+  that never reach a pivot decision or a stored factor entry (padded
+  rows are masked out of argmax candidates; padded columns only ever
+  receive exact-zero updates);
+* the VMEM residency budget that gates whole-panel fusion: a panel
+  whose working set cannot fit stays on the XLA ladder.  Honesty about
+  applicability is what keeps the ``panel_impl='auto'`` cost term
+  truthful -- the kernels never silently spill;
+* the interpret-mode decision: off-TPU the kernels run under
+  ``pl.pallas_call(interpret=True)`` so CPU CI executes the very same
+  kernel bodies -- bit-for-bit for the LU pivot sequence, residual-
+  bounded for Cholesky/QR -- against their XLA twins.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: float32 VMEM tile extents (sublane x lane); narrower dtypes pack more
+#: sublanes but (8, 128) alignment is valid for every dtype we ship.
+SUBLANE = 8
+LANE = 128
+
+#: Per-core VMEM the fused kernels may claim for one panel's working set
+#: (input + functional carries + packed output).  ~16 MiB/core is the
+#: architectural budget; claiming all of it would starve the compiler's
+#: own double-buffering, so the gate in :meth:`PanelPlan.use_pallas`
+#: divides this by the kernel's resident-copy count.
+PANEL_VMEM_BUDGET = 16 * 2 ** 20
+
+
+def round_up(n: int, m: int) -> int:
+    return -(-max(int(n), 1) // m) * m
+
+
+def interpret_default(interpret=None) -> bool:
+    """Resolve the ``interpret=`` tristate: explicit wins, else interpret
+    everywhere but real TPU (CPU CI runs the same kernel bodies)."""
+    if interpret is not None:
+        return bool(interpret)
+    return jax.default_backend() != "tpu"
+
+
+def pad_tiles(x):
+    """Zero-pad a 2-D operand up to (SUBLANE, LANE) tile multiples."""
+    m, n = x.shape
+    mp, np_ = round_up(m, SUBLANE), round_up(n, LANE)
+    if (mp, np_) == (m, n):
+        return x
+    return jnp.pad(x, ((0, mp - m), (0, np_ - n)))
+
+
+def pad_square(x):
+    """Zero-pad a square operand to a LANE multiple on both axes (the
+    Cholesky/larft kernels transpose in-kernel, so both axes must be
+    lane-aligned)."""
+    w = x.shape[0]
+    wp = round_up(w, LANE)
+    if wp == w:
+        return x
+    return jnp.pad(x, ((0, wp - w), (0, wp - w)))
+
+
+def panel_fits(shape, dtype, copies: int = 3,
+               budget: int = PANEL_VMEM_BUDGET) -> bool:
+    """Static gate: does ``copies`` tile-padded residents of this panel
+    fit the VMEM budget?  Evaluated per call site at trace time (shapes
+    are static), so the xla/pallas choice is baked into the jaxpr."""
+    mp = round_up(shape[0], SUBLANE)
+    np_ = round_up(shape[1], LANE)
+    return copies * mp * np_ * jnp.dtype(dtype).itemsize <= budget

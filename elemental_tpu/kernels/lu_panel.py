@@ -1,0 +1,155 @@
+"""Fused Pallas LU panel: pivot search, row swap, column scale, and the
+rank-1 / chunk-blocked trailing updates in ONE kernel launch.
+
+The XLA path (``lapack.lu._panel_lu``) lowers each column step to a
+handful of small ops -- argmax, two row gathers, two scatters, a divide,
+an outer product -- and the ``_INNERS`` chunk ladder adds a
+triangular-solve + matmul pair per chunk.  At nb = 256 that is O(10^3)
+tiny kernels on the factorization's serial spine.  Here the whole panel
+sits in VMEM and the column recurrence is a single ``lax.fori_loop``
+inside one ``pallas_call``; the packed L\\U factor and the pivot
+sequence come back in one store each.
+
+Two modes, selected by the static ``inner`` width:
+
+* ``inner=0`` -- the unblocked twin of ``_panel_lu_unb``.  Every op is
+  elementwise or an argmax (no reductions over changed extents), so the
+  pivot sequence and the packed factor are BIT-IDENTICAL to the XLA
+  reference, including first-max argmax tie-breaking.  This is the mode
+  the CPU CI pins.
+* ``inner=k`` -- the in-kernel analog of the ``_INNERS`` chunk ladder:
+  per-column rank-1 updates restricted to the current chunk, then a
+  forward-substitution U12 solve and one MXU-shaped trailing ``dot``
+  per chunk.  Same math as the ladder's ``triangular_solve`` + matmul
+  pair, different summation order -- residual-bounded, not bit-pinned.
+
+Pivot indices are returned as the per-step swap sequence (LAPACK ipiv
+convention, absolute panel rows); the composed permutation is replayed
+OUTSIDE the kernel by the exact bookkeeping ``_panel_lu_unb`` does on
+``perm`` -- integer swaps are not worth VMEM residency and keeping the
+kernel outputs matrix-shaped keeps the Mosaic lowering trivial.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from .common import interpret_default, pad_tiles
+
+
+def _swap_rows(X, j, p):
+    rowj = lax.dynamic_slice_in_dim(X, j, 1, 0)
+    rowp = lax.dynamic_slice_in_dim(X, p, 1, 0)
+    X = lax.dynamic_update_slice_in_dim(X, rowp, j, 0)
+    return lax.dynamic_update_slice_in_dim(X, rowj, p, 0)
+
+
+def _lu_panel_kernel(p_ref, out_ref, piv_ref, *, m, nbw, inner, precision):
+    P = p_ref[...]
+    mp, wp = P.shape
+    dt = P.dtype
+    ridx = lax.broadcasted_iota(jnp.int32, (mp, 1), 0)
+    cidx = lax.broadcasted_iota(jnp.int32, (1, wp), 1)
+    neg = jnp.asarray(-jnp.inf, dt)
+
+    def col_step(hi):
+        # factor column j in place, restricting the rank-1 update to
+        # columns (j, hi): hi == wp is the unblocked _panel_lu_unb twin
+        # (padded columns are zero, so updating them is a no-op), hi ==
+        # chunk end is the blocked-MXU mode.  Ops mirror the reference
+        # body exactly -- same candidate mask, same first-max argmax,
+        # same divide -- so the unblocked pivot sequence is bit-equal.
+        def body(j, carry):
+            P, piv = carry
+            col = lax.dynamic_slice_in_dim(P, j, 1, 1)
+            cand = jnp.where((ridx >= j) & (ridx < m), jnp.abs(col), neg)
+            p = jnp.argmax(cand).astype(jnp.int32)
+            P = _swap_rows(P, j, p)
+            piv = lax.dynamic_update_slice(piv, p[None, None], (j, 0))
+            pivval = lax.dynamic_slice(P, (j, j), (1, 1))
+            col = lax.dynamic_slice_in_dim(P, j, 1, 1)
+            colnew = jnp.where(ridx > j, col / pivval, col)
+            P = lax.dynamic_update_slice_in_dim(P, colnew, j, 1)
+            l = jnp.where(ridx > j, colnew, jnp.zeros_like(colnew))
+            urow = lax.dynamic_slice_in_dim(P, j, 1, 0)
+            urow = jnp.where((cidx > j) & (cidx < hi), urow,
+                             jnp.zeros_like(urow))
+            return P - l * urow, piv
+
+        return body
+
+    piv = jnp.zeros((wp, 1), jnp.int32)
+    if inner <= 0 or inner >= nbw:
+        P, piv = lax.fori_loop(0, nbw, col_step(wp), (P, piv))
+    else:
+        for s in range(0, nbw, inner):
+            e = min(s + inner, nbw)
+            P, piv = lax.fori_loop(s, e, col_step(e), (P, piv))
+            if e >= nbw:
+                break
+            # chunk tail, fused: U12 = L11^{-1} A12 by unit-diagonal
+            # forward substitution (the ladder's triangular_solve), then
+            # one MXU trailing dot A22 -= L21 @ U12 (the ladder's
+            # matmul) -- both on the VMEM-resident carry.
+            w = e - s
+            L11 = P[s:e, s:e]
+            tloc = lax.broadcasted_iota(jnp.int32, (1, w), 1)
+            trail = cidx >= e
+
+            def sub_body(i, U):
+                li = lax.dynamic_slice_in_dim(L11, i, 1, 0)
+                li = jnp.where(tloc < i, li, jnp.zeros_like(li))
+                corr = jnp.dot(li, U, precision=precision)
+                ui = lax.dynamic_slice_in_dim(U, i, 1, 0)
+                return lax.dynamic_update_slice_in_dim(U, ui - corr, i, 0)
+
+            A12 = jnp.where(trail, P[s:e, :], jnp.zeros((w, wp), dt))
+            U12 = lax.fori_loop(0, w, sub_body, A12)
+            P = P.at[s:e, :].set(jnp.where(trail, U12, P[s:e, :]))
+            L21 = jnp.where(ridx >= e, P[:, s:e], jnp.zeros((mp, w), dt))
+            P = P - jnp.dot(L21, U12, precision=precision)
+    out_ref[...] = P
+    piv_ref[...] = piv
+
+
+def lu_panel(P, nbw: int, precision=None, *, inner: int = 0,
+             interpret=None):
+    """Fused twin of ``lapack.lu._panel_lu``: one launch, same contract
+    ``(packed L\\U, composed row permutation)``.
+
+    Real dtypes only -- callers gate complex panels back to the XLA
+    ladder (the dispatch in ``PanelPlan.use_pallas``); reaching here
+    with a complex panel is a caller bug and raises loudly.
+    """
+    M, w = P.shape
+    nbw = int(nbw)
+    if jnp.issubdtype(P.dtype, jnp.complexfloating):
+        raise ValueError("pallas LU panel is real-only; the panel_impl "
+                         "dispatch falls back to xla for complex dtypes")
+    Pp = pad_tiles(P)
+    mp, wp = Pp.shape
+    kern = functools.partial(_lu_panel_kernel, m=M, nbw=nbw,
+                             inner=int(inner), precision=precision)
+    packed, piv = pl.pallas_call(
+        kern,
+        out_shape=(jax.ShapeDtypeStruct((mp, wp), P.dtype),
+                   jax.ShapeDtypeStruct((wp, 1), jnp.int32)),
+        interpret=interpret_default(interpret),
+    )(Pp)
+    packed = packed[:M, :w]
+    piv = piv[:nbw, 0]
+
+    # replay the per-step swap sequence into the composed permutation --
+    # exactly the bookkeeping _panel_lu_unb does on `perm`, hoisted out
+    # of the kernel (integer swaps don't earn VMEM residency).
+    def body(j, perm):
+        p = piv[j]
+        pj, pp_ = perm[j], perm[p]
+        return perm.at[j].set(pp_).at[p].set(pj)
+
+    perm = lax.fori_loop(0, nbw, body, jnp.arange(M))
+    return packed, perm

@@ -1,0 +1,114 @@
+"""Fused Pallas QR panel: the larfg reflector chain AND the larft
+T-triangle build in one kernel launch.
+
+The XLA path runs ``lapack.qr._panel_qr`` (per column: a norm, a
+divide, one (1, n) row dot, one rank-1 update) and then ``_larft`` (a
+Gram matmul plus k small matvecs) as separate fori_loops -- dozens of
+latency-bound launches per panel on the factorization spine.  Here the
+panel is VMEM-resident: the reflector chain, the Gram product
+``V^H V``, and the forward-columnwise T recurrence all run inside one
+``pallas_call``, returning ``(packed V\\R, tau, T)`` so the driver
+skips the separate ``_larft`` call entirely.
+
+The kernel body mirrors the reference op-for-op (same degenerate
+guards, same HIGHEST-precision dots), but the padded-operand reductions
+group differently than the XLA (M,)-vector sums, so the twin contract
+is residual-bounded (``Q R ~ A``, orthonormal Q), not bit-pinned --
+see ``tests/kernels/test_qr_panel.py`` for the documented bounds.
+Real dtypes only; complex panels are gated back to XLA by the
+``panel_impl`` dispatch.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from .common import LANE, interpret_default, pad_tiles, round_up
+
+_HI = lax.Precision.HIGHEST
+
+
+def _qr_panel_kernel(p_ref, out_ref, tau_ref, t_ref, *, m, k):
+    P = p_ref[...]
+    mp, wp = P.shape
+    dt = P.dtype
+    ridx = lax.broadcasted_iota(jnp.int32, (mp, 1), 0)
+    cidx = lax.broadcasted_iota(jnp.int32, (1, wp), 1)
+
+    def body(j, state):
+        # the larfg recurrence of _panel_qr, column-masked: padded rows
+        # are zero and contribute exact zeros to sigma / the row dot
+        P, tau = state
+        col = lax.dynamic_slice_in_dim(P, j, 1, 1)
+        alpha = lax.dynamic_slice(P, (j, j), (1, 1))[0, 0]
+        tail = jnp.where(ridx > j, col, 0)
+        sigma = jnp.sum(jnp.abs(tail) ** 2)
+        anorm = jnp.sqrt(jnp.abs(alpha) ** 2 + sigma)
+        re_a = jnp.real(alpha)
+        beta = -jnp.sign(jnp.where(re_a == 0, 1.0, re_a)) * anorm
+        degenerate = anorm == 0
+        safe_beta = jnp.where(degenerate, 1.0, beta)
+        tau_j = jnp.where(degenerate, 0.0, (safe_beta - alpha) / safe_beta)
+        denom = alpha - safe_beta
+        safe_denom = jnp.where(denom == 0, 1.0, denom)
+        v = jnp.where(ridx > j, col / safe_denom, jnp.zeros_like(col))
+        v = jnp.where(ridx == j,
+                      jnp.where(degenerate, 0.0, 1.0).astype(dt), v)
+        w = jnp.dot(jnp.swapaxes(jnp.conj(v), 0, 1), P, precision=_HI)
+        upd = (jnp.conj(tau_j) * v) * w
+        P = P - jnp.where(cidx > j, upd, 0)
+        newcol = jnp.where(ridx > j, v, col)
+        newcol = jnp.where(ridx == j, jnp.asarray(beta, dt), newcol)
+        P = lax.dynamic_update_slice_in_dim(P, newcol, j, 1)
+        tau = lax.dynamic_update_slice(
+            tau, jnp.asarray(tau_j, dt).reshape(1, 1), (j, 0))
+        return P, tau
+
+    P, tau = lax.fori_loop(0, k, body, (P, jnp.zeros((wp, 1), dt)))
+
+    # larft, fused: V from the packed panel, one Gram dot, then the
+    # forward-columnwise T recurrence of _larft.  Padded V columns are
+    # unit vectors e_j but every T write is masked to kidx < i < k, so
+    # the padded border of T stays exactly zero.
+    V = jnp.tril(P, -1) + jnp.eye(mp, wp, dtype=dt)
+    B = jnp.dot(jnp.swapaxes(jnp.conj(V), 0, 1), V, precision=_HI)
+    kidx = lax.broadcasted_iota(jnp.int32, (wp, 1), 0)
+
+    def tbody(i, T):
+        coli = lax.dynamic_slice_in_dim(B, i, 1, 1)
+        coli = jnp.where(kidx < i, coli, jnp.zeros_like(coli))
+        taui = lax.dynamic_slice(tau, (i, 0), (1, 1))[0, 0]
+        newcol = -taui * jnp.dot(T, coli, precision=_HI)
+        newcol = jnp.where(kidx == i, taui.astype(dt), newcol)
+        return lax.dynamic_update_slice_in_dim(T, newcol, i, 1)
+
+    T = lax.fori_loop(0, k, tbody, jnp.zeros((wp, wp), dt))
+    out_ref[...] = P
+    tau_ref[...] = tau
+    t_ref[...] = T
+
+
+def qr_panel(P, *, interpret=None):
+    """Fused twin of ``lapack.qr._panel_qr`` + ``_larft``: one launch
+    returning ``(packed V\\R, tau, T)`` with the same LAPACK larfg
+    conventions (real beta, H_j = I - tau_j v_j v_j^H applied as H^H)."""
+    M, k = P.shape
+    if jnp.issubdtype(P.dtype, jnp.complexfloating):
+        raise ValueError("pallas QR panel is real-only; the panel_impl "
+                         "dispatch falls back to xla for complex dtypes")
+    Pp = pad_tiles(P)
+    mp, wp = Pp.shape
+    tp = round_up(wp, LANE)
+    kern = functools.partial(_qr_panel_kernel, m=M, k=k)
+    packed, tau, T = pl.pallas_call(
+        kern,
+        out_shape=(jax.ShapeDtypeStruct((mp, wp), P.dtype),
+                   jax.ShapeDtypeStruct((wp, 1), P.dtype),
+                   jax.ShapeDtypeStruct((tp, tp), P.dtype)),
+        interpret=interpret_default(interpret),
+    )(Pp)
+    return packed[:M, :k], tau[:k, 0], T[:k, :k]

@@ -76,11 +76,20 @@ from .lu import _hi, _NULL_TIMER, _phase_hook
 _CROSSOVER = 4096
 
 
-def _potrf_inv(D, precision, bs: int = 512):
+def _potrf_inv(D, precision, bs: int = 512, plan=None):
     """:func:`_potrf_inv_impl` routed through the engine's ``'compute'``
     fault seam (identity unless a FaultPlan is installed -- ISSUE 9):
     the diagonal-block factor/inverse pair IS cholesky's local panel
-    math, so corrupting it here models a soft error in local compute."""
+    math, so corrupting it here models a soft error in local compute.
+
+    ``plan`` (a ``kernels.PanelPlan``) selects the implementation: the
+    fused Pallas kernel (``kernels.potrf_inv`` -- blocked potrf +
+    triangular inverse in ONE launch) when the resolved ``panel_impl``
+    says so and the block passes the static VMEM/dtype gate; else the
+    XLA path.  Both land on the same fault seam."""
+    if plan is not None and plan.use_pallas(D.shape, D.dtype, copies=4):
+        from ..kernels import potrf_inv as _pallas_potrf_inv
+        return apply_fault("compute", _pallas_potrf_inv(D, precision, bs=bs))
     return apply_fault("compute", _potrf_inv_impl(D, precision, bs))
 
 
@@ -136,7 +145,7 @@ def _potrf_inv_impl(D, precision, bs: int = 512):
 
 
 def _local_chol_array(a, n: int, ib: int, precision, lookahead: bool = True,
-                      timer=None):
+                      timer=None, plan=None):
     """Blocked lower Cholesky of an (n, n) array (lower triangle valid),
     returning the full lower-triangular factor array.  Shared by the p == 1
     driver and the distributed tail crossover (where it runs REPLICATED on
@@ -166,7 +175,7 @@ def _local_chol_array(a, n: int, ib: int, precision, lookahead: bool = True,
     nxt = None
     if lookahead:
         w0 = min(ib, n)
-        L11, Li11 = _potrf_inv(T[:w0, :w0], precision)
+        L11, Li11 = _potrf_inv(T[:w0, :w0], precision, plan=plan)
         tm.tick("diag", 0, L11)
         L21 = None
         if w0 < n:
@@ -179,7 +188,7 @@ def _local_chol_array(a, n: int, ib: int, precision, lookahead: bool = True,
         if lookahead:
             L11, Li11, L21 = nxt
         else:
-            L11, Li11 = _potrf_inv(T[:w, :w], precision)
+            L11, Li11 = _potrf_inv(T[:w, :w], precision, plan=plan)
             tm.tick("diag", k, L11)
             L21 = None
             if s + w < n:
@@ -208,7 +217,7 @@ def _local_chol_array(a, n: int, ib: int, precision, lookahead: bool = True,
         w2 = min(ib, mt)
         strip = T2[:, :w2] - jnp.matmul(L21, jnp.conj(L21[:w2, :]).T,
                                         precision=precision).astype(dt)
-        L11n, Li11n = _potrf_inv(strip[:w2, :w2], precision)
+        L11n, Li11n = _potrf_inv(strip[:w2, :w2], precision, plan=plan)
         tm.tick("diag", k + 1, L11n)
         L21n = None
         if w2 < mt:
@@ -233,20 +242,22 @@ def _local_chol_array(a, n: int, ib: int, precision, lookahead: bool = True,
 
 
 def _local_cholesky(A: DistMatrix, nb: int | None, precision,
-                    lookahead: bool = True, timer=None) -> DistMatrix:
+                    lookahead: bool = True, timer=None,
+                    plan=None) -> DistMatrix:
     """Sequential (p == 1) lower path: the analog of the reference's local
     ``Matrix<T>`` dispatch onto sequential BLAS.  On a 1x1 grid the storage
     array IS the global matrix, so the whole blocked loop is one fused XLA
     program with no shard_map/redistribute sub-computation boundaries."""
     ib = max(nb or 2048, 1)
     out = _local_chol_array(A.local, A.gshape[0], ib, precision,
-                            lookahead=lookahead, timer=timer)
+                            lookahead=lookahead, timer=timer, plan=plan)
     return make_trapezoidal(A.with_local(out), "L")
 
 
 def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
              precision=None, lookahead: bool | str = True,
              crossover: int | str | None = None,
+             panel_impl: str | None = None,
              comm_precision: str | None = None,
              redist_path: str | None = None, timer=None,
              health=None, abft=None) -> DistMatrix:
@@ -259,6 +270,17 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
     gathers the tail once and finishes locally (``None`` = :data:`_CROSSOVER`
     with look-ahead, disabled classic; 0 never crosses over); ``timer``
     enables eager per-phase wall-clock attribution (``perf/phase_timer.py``).
+
+    ``panel_impl`` (``None`` | ``'xla'`` | ``'pallas'`` | ``'auto'``)
+    selects the diagonal-block factor/inverse IMPLEMENTATION: ``'pallas'``
+    runs :func:`_potrf_inv` as ONE fused VMEM-resident kernel
+    (``kernels.potrf_inv``: blocked potrf + triangular inverse in a
+    single launch; ``interpret=True`` off-TPU), ``None``/``'xla'`` keep
+    the blocked XLA path.  Residual-bounded twin (same math, different
+    scalar-recurrence rounding -- pinned by ``tests/kernels``); complex
+    dtypes and oversize blocks fall back to XLA silently.  The schedule
+    and every collective are IDENTICAL under either value (comm-plan
+    goldens byte-pinned by ``tools/check.sh kernels``).
 
     ``comm_precision`` (``None`` | ``'bf16'`` | ``'int8'``) selects the
     WIRE precision of the schedule's redistributions -- the diagonal-block
@@ -299,24 +321,30 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
     """
     _check_mcmr(A)
     if any(isinstance(v, str) for v in (nb, lookahead, crossover)) \
-            or comm_precision == "auto" or redist_path == "auto":
+            or comm_precision == "auto" or redist_path == "auto" \
+            or panel_impl == "auto":
         from ..tune.policy import resolve_knobs
         kn = resolve_knobs("cholesky", gshape=A.gshape, dtype=A.dtype,
                            grid=A.grid, knobs={"nb": nb, "lookahead": lookahead,
                                                "crossover": crossover,
+                                               "panel_impl": panel_impl,
                                                "comm_precision": comm_precision,
                                                "redist_path": redist_path})
         nb, lookahead, crossover = kn["nb"], kn["lookahead"], kn["crossover"]
         comm_precision = kn["comm_precision"]
         redist_path = kn["redist_path"]
+        panel_impl = kn["panel_impl"]
     check_comm_precision(comm_precision)
     rp = redist_path
+    from ..kernels import resolve_panel
+    plan = resolve_panel(panel_impl, dtype=A.dtype)
     if uplo.upper().startswith("U"):
         # U = (lower factor of A^H-as-lower)^H; A hermitian so the data of
         # the upper triangle, conj-transposed, is the lower triangle.
         Alow = redistribute(transpose_dist(A, conj=True), MC, MR)
         L = cholesky(Alow, "L", nb=nb, precision=precision,
                      lookahead=lookahead, crossover=crossover,
+                     panel_impl=panel_impl,
                      comm_precision=comm_precision, redist_path=redist_path,
                      timer=timer, health=health, abft=abft)
         return redistribute(transpose_dist(L, conj=True), MC, MR)
@@ -324,7 +352,7 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
         from ..resilience.abft import abft_cholesky
         return abft_cholesky(A, nb=nb, precision=precision,
                              comm_precision=comm_precision, timer=timer,
-                             health=health, abft=abft)
+                             health=health, abft=abft, plan=plan)
 
     m = A.gshape[0]
     if A.gshape != (m, m):
@@ -337,7 +365,7 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
         tm, hm = attach_health("cholesky", health, tm, scale_from=A)
     tm.start()
     if g.size == 1:
-        out = _local_cholesky(A, nb, precision, lookahead, tm)
+        out = _local_cholesky(A, nb, precision, lookahead, tm, plan)
         if hm is not None:
             hm.report()
         return out
@@ -351,7 +379,7 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
         e0 = min(ib, m)
         A11 = redistribute(view(L, rows=(0, e0), cols=(0, e0)), STAR, STAR,
                            comm_precision=comm_precision, path=rp)
-        L11, Li11 = _potrf_inv(A11.local, precision)
+        L11, Li11 = _potrf_inv(A11.local, precision, plan=plan)
         tm.tick("diag", 0, L11)
         L21_vc = None
         if e0 < m:
@@ -374,7 +402,7 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
             # replicated diagonal-block factor + inverse: every device runs
             # the same deterministic _potrf_inv, so the panel Trsm below is
             # a matmul
-            L11, Li11 = _potrf_inv(A11.local, precision)
+            L11, Li11 = _potrf_inv(A11.local, precision, plan=plan)
             tm.tick("diag", k, L11)
         L11_ss = DistMatrix(L11, (e - s, e - s), STAR, STAR, 0, 0, g)
         L = update_view(L, redistribute(L11_ss, MC, MR), rows=(s, e), cols=(s, e))
@@ -417,7 +445,7 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
                 A11n = redistribute(view(stripD, rows=(0, e2 - e),
                                          cols=(0, e2 - e)), STAR, STAR,
                                     comm_precision=comm_precision, path=rp)
-                L11n, Li11n = _potrf_inv(A11n.local, precision)
+                L11n, Li11n = _potrf_inv(A11n.local, precision, plan=plan)
                 tm.tick("diag", k + 1, L11n)
                 L21n_vc = None
                 if e2 < m:
@@ -458,7 +486,7 @@ def cholesky(A: DistMatrix, uplo: str = "L", nb: int | str | None = None,
                                  STAR, STAR,
                                  comm_precision=comm_precision, path=rp)
             lt = _local_chol_array(Atail.local, m - e, ib, precision,
-                                   lookahead=lookahead)
+                                   lookahead=lookahead, plan=plan)
             Lt_ss = DistMatrix(lt, (m - e, m - e), STAR, STAR, 0, 0, g)
             L = update_view(L, redistribute(Lt_ss, MC, MR),
                             rows=(e, m), cols=(e, m))

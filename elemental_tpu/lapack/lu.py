@@ -90,9 +90,15 @@ from ..blas.level3 import _blocksize, _check_mcmr, local_rank_update, trsm
 #: on v5e at n=16384 nb=2048 (perf/ab_harness.py, same-process roofline
 #: brackets): (512,64) 8.18/7.34 TFLOP/s across two runs vs (256,32) 6.53,
 #: (256,64) 6.89, (1024,128) 6.92, (512,64,16) 4.89, (768,96) 7.46.
-#: ``perf/ab_harness.py lu`` sweeps this ladder against the look-ahead
-#: schedule; re-pin here when a sweep on the target chip says otherwise.
-_INNERS = (512, 64)
+#: The pinned tuple now lives in ``kernels.DEFAULT_INNERS`` (single
+#: source shared with the ``panel_impl`` dispatch and bench provenance
+#: -- ISSUE 17); sweep with ``perf/ab_harness.py lu`` (which passes
+#: ``inners=`` explicitly, no module monkeypatching) and re-pin THERE.
+#: This module-level alias survives for historical importers only.
+from ..kernels import default_inners as _default_inners
+from ..kernels import resolve_panel as _resolve_panel
+
+_INNERS = _default_inners()
 
 
 def _hi(precision):
@@ -222,6 +228,19 @@ def _panel_lu(P, nbw: int, precision=None, inners=None):
         P = P.at[s:].set(rows)
         perm = perm.at[s:].set(jnp.take(perm[s:], sperm, axis=0))
     return P, perm
+
+
+def _panel_dispatch(P, nbw: int, precision=None, plan=None):
+    """Route one replicated panel through the resolved ``panel_impl``
+    plan (``kernels.PanelPlan``): the fused Pallas kernel when the plan
+    says so AND the panel passes the static VMEM/dtype gate, else the
+    XLA chunk ladder with the plan's ``inners``.  ``plan=None`` is the
+    status-quo ladder -- every historical caller is unchanged."""
+    if plan is not None and plan.use_pallas(P.shape, P.dtype):
+        from ..kernels import lu_panel
+        return lu_panel(P, nbw, precision, inner=plan.pallas_inner)
+    inners = plan.inners if plan is not None else None
+    return _panel_lu(P, nbw, precision, inners)
 
 
 # ---------------------------------------------------------------------
@@ -501,7 +520,8 @@ def _rowblock_solve_jit(Ablk: DistMatrix, Li11, precision, wire=None):
 # ---------------------------------------------------------------------
 
 def _local_lu(A: DistMatrix, nb: int | None, precision,
-              update_precision=None, lookahead: bool = True, timer=None):
+              update_precision=None, lookahead: bool = True, timer=None,
+              plan=None):
     """Sequential (p == 1) path: on a 1x1 grid the storage array IS the
     global matrix, so the blocked loop fuses into one XLA program with no
     redistribute sub-computation boundaries (the local ``Matrix<T>``
@@ -510,13 +530,13 @@ def _local_lu(A: DistMatrix, nb: int | None, precision,
     right-looking order (the A/B baseline)."""
     a, perm = _local_lu_array(A.local, A.gshape[0], A.gshape[1],
                               max(nb or 1024, 1), precision,
-                              update_precision, lookahead, timer)
+                              update_precision, lookahead, timer, plan)
     return A.with_local(a), perm
 
 
 def _local_lu_array(a, m: int, n: int, ib: int, precision,
                     update_precision=None, lookahead: bool = True,
-                    timer=None):
+                    timer=None, plan=None):
     """Blocked LU of a plain (replicated) array: the sequential engine
     behind both the 1x1-grid path and the distributed loop's
     crossover-to-local tail.  Returns ``(packed LU array, perm)``."""
@@ -527,7 +547,7 @@ def _local_lu_array(a, m: int, n: int, ib: int, precision,
     tm.start()
     if lookahead:
         w0 = min(ib, kend)
-        nxt = _panel_lu(a[:, :w0], w0, precision)
+        nxt = _panel_dispatch(a[:, :w0], w0, precision, plan)
         tm.tick("panel", 0, nxt)
     for k, s in enumerate(range(0, kend, ib)):
         e = min(s + ib, kend)
@@ -535,7 +555,7 @@ def _local_lu_array(a, m: int, n: int, ib: int, precision,
         if lookahead:
             Pf, pperm = nxt
         else:
-            Pf, pperm = _panel_lu(a[s:, s:e], nbw, precision)
+            Pf, pperm = _panel_dispatch(a[s:, s:e], nbw, precision, plan)
             tm.tick("panel", k, Pf, pperm)
         perm = perm.at[s:].set(jnp.take(perm[s:], pperm, axis=0))
         # full trailing-block gather + contiguous writeback (TPU scatters
@@ -566,7 +586,7 @@ def _local_lu_array(a, m: int, n: int, ib: int, precision,
         L21 = Pf[nbw:]
         strip = a[e:, e:e2] - jnp.matmul(L21, U1n[:, :w],
                                          precision=upd).astype(a.dtype)
-        nxt = _panel_lu(strip, w, precision)
+        nxt = _panel_dispatch(strip, w, precision, plan)
         tm.tick("panel", k + 1, nxt)
         a = a.at[s:e, e:].set(U1n)
         if e2 < n:
@@ -592,6 +612,7 @@ _CROSSOVER = 4096
 def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
        update_precision=None, lookahead: bool | str = True,
        crossover: int | str | None = None, panel: str = "classic",
+       panel_impl: str | None = None, inners=None,
        comm_precision: str | None = None, redist_path: str | None = None,
        timer=None, health=None, abft=None):
     """Blocked right-looking LU with partial pivoting and look-ahead.
@@ -628,6 +649,24 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
         single-row grids (r == 1, incl. 1x1) calu degenerates to classic
         exactly.  The crossover tail finishes with the local classic
         kernel under either strategy.
+
+    ``panel_impl`` (``None`` | ``'xla'`` | ``'pallas'`` | ``'auto'``)
+    selects the panel IMPLEMENTATION, orthogonal to the ``panel``
+    strategy above: ``'pallas'`` runs the classic replicated panel as
+    ONE fused VMEM-resident kernel (``kernels.lu_panel``: pivot search,
+    row swaps, column scales, and chunk-blocked trailing updates in a
+    single launch; off-TPU it executes under ``interpret=True``), while
+    ``None``/``'xla'`` keep the status-quo chunk ladder.  The fused
+    kernel's pivot sequence is bit-identical to the ladder's unblocked
+    base case (same first-max argmax tie-break, pinned by
+    ``tests/kernels``); complex dtypes and panels whose working set
+    exceeds the VMEM budget fall back to the XLA twin silently (the
+    knob is a performance hint, never a semantics change).  Tree panels
+    (``panel='calu'`` tournaments) keep their XLA slab kernels -- the
+    knob covers the classic primitives, including the sequential tail.
+    ``inners`` optionally overrides the chunk-width ladder
+    (``kernels.DEFAULT_INNERS``) for BOTH implementations; the A/B
+    harness sweeps it through this argument.
 
     ``comm_precision`` (``None`` | ``'bf16'`` | ``'int8'``) selects the
     WIRE precision of the schedule's bulk redistributions (panel gathers,
@@ -682,24 +721,27 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
     _check_mcmr(A)
     if any(isinstance(v, str) for v in (nb, lookahead, crossover)) \
             or panel == "auto" or comm_precision == "auto" \
-            or redist_path == "auto":
+            or redist_path == "auto" or panel_impl == "auto":
         from ..tune.policy import resolve_knobs
         kn = resolve_knobs("lu", gshape=A.gshape, dtype=A.dtype, grid=A.grid,
                            knobs={"nb": nb, "lookahead": lookahead,
                                   "crossover": crossover, "panel": panel,
+                                  "panel_impl": panel_impl,
                                   "comm_precision": comm_precision,
                                   "redist_path": redist_path})
         nb, lookahead, crossover = kn["nb"], kn["lookahead"], kn["crossover"]
         panel, comm_precision = kn["panel"], kn["comm_precision"]
         redist_path = kn["redist_path"]
+        panel_impl = kn["panel_impl"]
     check_comm_precision(comm_precision)
     rp = redist_path
+    plan = _resolve_panel(panel_impl, dtype=A.dtype, inners=inners)
     if abft:
         from ..resilience.abft import abft_lu
         return abft_lu(A, nb=nb, precision=precision,
                        update_precision=update_precision,
                        comm_precision=comm_precision, timer=timer,
-                       health=health, abft=abft)
+                       health=health, abft=abft, plan=plan)
     if panel is None:
         panel = "classic"
     if panel not in ("classic", "calu"):
@@ -713,7 +755,8 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
         from ..resilience.health import attach_health
         tm, hm = attach_health("lu", health, tm, scale_from=A)
     if g.size == 1:
-        out = _local_lu(A, nb, precision, update_precision, lookahead, tm)
+        out = _local_lu(A, nb, precision, update_precision, lookahead, tm,
+                        plan)
         if hm is not None:
             hm.report()
         return out
@@ -726,7 +769,7 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
         The packed result routes through the engine's 'compute' fault
         seam (identity unless a FaultPlan is installed -- ISSUE 9)."""
         if not calu or Ploc.shape[0] <= w:
-            Pf, pperm = _panel_lu(Ploc, w, precision)
+            Pf, pperm = _panel_dispatch(Ploc, w, precision, plan)
         else:
             pperm = _tournament_pivots(Ploc, w, r)
             tm.tick("tournament", step, pperm)
@@ -827,7 +870,8 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
                 tm.tick("update", k, A)
             if tail:
                 A, perm = _lu_tail(A, perm, e, ib, precision, upd,
-                                   lookahead, tm, k, comm_precision, rp)
+                                   lookahead, tm, k, comm_precision, rp,
+                                   plan)
                 break
             continue
         # look-ahead: split the trailing update at the next panel boundary.
@@ -869,7 +913,7 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
         tm.tick("update", k, A)
         if tail:
             A, perm = _lu_tail(A, perm, e, ib, precision, upd, lookahead,
-                               tm, k, comm_precision, rp)
+                               tm, k, comm_precision, rp, plan)
             break
     if hm is not None:
         hm.report()
@@ -878,7 +922,7 @@ def lu(A: DistMatrix, nb: int | str | None = None, precision=None,
 
 def _lu_tail(A: DistMatrix, perm, e: int, ib: int, precision, upd,
              lookahead: bool, tm, k: int, comm_precision=None,
-             redist_path=None):
+             redist_path=None, plan=None):
     """Crossover-to-local finish of the (fully updated) trailing block.
 
     One [STAR,STAR] gather of rows/cols >= e, a replicated run of the
@@ -892,7 +936,7 @@ def _lu_tail(A: DistMatrix, perm, e: int, ib: int, precision, upd,
     Atail = redistribute(view(A, rows=(e, m), cols=(e, n)), STAR, STAR,
                          comm_precision=comm_precision, path=redist_path)
     at, pt = _local_lu_array(Atail.local, m - e, n - e, ib, precision,
-                             upd, lookahead)
+                             upd, lookahead, plan=plan)
     # the tail's composed row permutation applies to the WHOLE row range
     # (the left factored columns must see the same swaps); cols >= e are
     # overwritten by the factored-tail writeback right after

@@ -105,6 +105,20 @@ def _panel_v(Pf):
     return jnp.tril(Pf, -1) + jnp.eye(M, k, dtype=Pf.dtype)
 
 
+def _panel_qr_dispatch(P, plan=None):
+    """Route one classic replicated panel through the resolved
+    ``panel_impl`` plan: returns ``(packed, tau, T)`` with ``T`` the
+    fused kernel's larft triangle when the Pallas path ran, else
+    ``None`` (the caller builds T via :func:`_larft` exactly as
+    before).  ``plan=None`` / complex / oversize panels keep the XLA
+    larfg recurrence -- the status-quo path, bit-identical."""
+    if plan is not None and plan.use_pallas(P.shape, P.dtype, copies=4):
+        from ..kernels import qr_panel
+        return qr_panel(P)
+    Pf, tau = _panel_qr(P)
+    return Pf, tau, None
+
+
 # ---------------------------------------------------------------------
 # TSQR/CAQR tree panel (the QR rider of the CALU PR): local Householder
 # QR per grid-row slab, a log-depth pairwise reduction of the R factors,
@@ -194,7 +208,8 @@ def _panel_qr_tsqr(P, r: int, precision=None):
 # ---------------------------------------------------------------------
 
 def qr(A: DistMatrix, nb: int | str | None = None, precision=None,
-       panel: str = "classic", comm_precision: str | None = None,
+       panel: str = "classic", panel_impl: str | None = None,
+       comm_precision: str | None = None,
        timer=None, health=None, redist_path: str | None = None,
        abft=None):
     """Blocked Householder QR; returns (packed, tau) in geqrf format.
@@ -217,6 +232,17 @@ def qr(A: DistMatrix, nb: int | str | None = None, precision=None,
     consume the result unchanged (R's diagonal signs may differ from
     classic; the (packed, tau) pair is self-consistent).  ``'auto'``
     resolves through the tuning subsystem like ``nb``.
+
+    ``panel_impl`` (``None`` | ``'xla'`` | ``'pallas'`` | ``'auto'``)
+    selects the classic panel's IMPLEMENTATION, orthogonal to ``panel``:
+    ``'pallas'`` fuses the whole larfg reflector chain AND the larft
+    T-triangle build into ONE VMEM-resident kernel
+    (``kernels.qr_panel``; ``interpret=True`` off-TPU), so the driver
+    skips the separate ``_larft`` launch per step.  Residual-bounded
+    twin of the XLA recurrence (pinned by ``tests/kernels``); complex
+    dtypes and oversize panels fall back to XLA silently; the TSQR tree
+    panel keeps its slab kernels.  The schedule and every collective
+    are identical under either value (comm-plan goldens byte-pinned).
 
     ``comm_precision`` (``None`` | ``'bf16'`` | ``'int8'`` | ``'auto'``)
     selects the wire precision of the per-step panel gathers (the
@@ -254,15 +280,17 @@ def qr(A: DistMatrix, nb: int | str | None = None, precision=None,
     m, n = A.gshape
     g = A.grid
     if isinstance(nb, str) or panel == "auto" or comm_precision == "auto" \
-            or redist_path == "auto":
+            or redist_path == "auto" or panel_impl == "auto":
         from ..tune.policy import resolve_knobs
         kn = resolve_knobs("qr", gshape=A.gshape, dtype=A.dtype, grid=g,
                            knobs={"nb": nb, "panel": panel,
+                                  "panel_impl": panel_impl,
                                   "comm_precision": comm_precision,
                                   "redist_path": redist_path})
         nb, panel, comm_precision = kn["nb"], kn["panel"], \
             kn["comm_precision"]
         redist_path = kn.get("redist_path")
+        panel_impl = kn.get("panel_impl")
     from ..redist.quantize import check_comm_precision
     check_comm_precision(comm_precision)
     if panel is None:
@@ -270,11 +298,13 @@ def qr(A: DistMatrix, nb: int | str | None = None, precision=None,
     if panel not in ("classic", "tsqr"):
         raise ValueError(f"qr: unknown panel strategy {panel!r}; "
                          "expected 'classic', 'tsqr', or 'auto'")
+    from ..kernels import resolve_panel
+    plan = resolve_panel(panel_impl, dtype=A.dtype)
     if abft:
         from ..resilience.abft import abft_qr
         return abft_qr(A, nb=nb, precision=precision, panel=panel,
                        comm_precision=comm_precision, timer=timer,
-                       health=health, abft=abft)
+                       health=health, abft=abft, plan=plan)
     tm = _phase_hook("qr", timer)
     hm = None
     if health:
@@ -293,10 +323,11 @@ def qr(A: DistMatrix, nb: int | str | None = None, precision=None,
                                 STAR, STAR,
                                 comm_precision=comm_precision,
                                 path=redist_path)
+        Tk = None
         if panel == "tsqr":
             Pf, tau = _panel_qr_tsqr(panel_ss.local[:, :nbw], r, precision)
         else:
-            Pf, tau = _panel_qr(panel_ss.local[:, :nbw])
+            Pf, tau, Tk = _panel_qr_dispatch(panel_ss.local[:, :nbw], plan)
         Pf, = apply_fault("compute", (Pf,))
         taus.append(tau)
         tm.tick("panel", k, Pf, tau)
@@ -308,7 +339,7 @@ def qr(A: DistMatrix, nb: int | str | None = None, precision=None,
         A = _update_cols_lt(A, redistribute(Pf_ss, MC, MR), (s, m), (s, e_up), e)
         if e < n:
             V = _panel_v(Pf)
-            T = _larft(V, tau)
+            T = Tk if Tk is not None else _larft(V, tau)
             V_ss = DistMatrix(V, (m - s, nbw), STAR, STAR, 0, 0, g)
             V_mc = redistribute(V_ss, MC, STAR)
             A2 = view(A, rows=(s, m), cols=(s, n))

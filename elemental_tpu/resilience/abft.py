@@ -374,7 +374,8 @@ def resolve_abft(abft) -> AbftGuard:
 # ---------------------------------------------------------------------
 
 def abft_lu(A, nb=None, precision=None, update_precision=None,
-            comm_precision=None, timer=None, health=None, abft=True):
+            comm_precision=None, timer=None, health=None, abft=True,
+            plan=None):
     """Checksum-guarded LU with partial pivoting (see module docstring).
 
     Same ``(packed LU, perm)`` contract as ``lapack.lu``; the schedule
@@ -387,8 +388,9 @@ def abft_lu(A, nb=None, precision=None, update_precision=None,
     from ..redist.engine import apply_fault, redistribute
     from ..blas.level3 import _blocksize, local_rank_update
     from ..lapack.lu import (_apply_swaps_moved, _hi, _moved_rows,
-                             _panel_lu, _phase_hook, _unit_lower_inv,
-                             _update_cols_ge, _update_cols_lt)
+                             _panel_dispatch, _phase_hook,
+                             _unit_lower_inv, _update_cols_ge,
+                             _update_cols_lt)
     from .recovery import run_step
     from .health import attach_health
 
@@ -426,7 +428,7 @@ def abft_lu(A, nb=None, precision=None, update_precision=None,
         ploc = panel.local[:m - s, :e_up - s]
         guard.check("panel_gather", pan_sum, jnp.sum(ploc, axis=0),
                     mass=pan_mass, kind="transport", rows=m - s)
-        Pf, pperm = _panel_lu(ploc[:, :nbw], nbw, precision)
+        Pf, pperm = _panel_dispatch(ploc[:, :nbw], nbw, precision, plan)
         Pf, = apply_fault("compute", (Pf,))
         # factor invariant: colsums survive the panel's row permutation
         cL = (jnp.sum(jnp.tril(Pf[:nbw], -1), axis=0)
@@ -524,7 +526,7 @@ def abft_lu(A, nb=None, precision=None, update_precision=None,
 # ---------------------------------------------------------------------
 
 def abft_cholesky(A, nb=None, precision=None, comm_precision=None,
-                  timer=None, health=None, abft=True):
+                  timer=None, health=None, abft=True, plan=None):
     """Checksum-guarded lower Cholesky (see module docstring).  Same
     contract as ``lapack.cholesky(..., uplo='L')``; reached via
     ``cholesky(..., abft=)``."""
@@ -565,7 +567,7 @@ def abft_cholesky(A, nb=None, precision=None, comm_precision=None,
         aloc = A11.local[:w, :w]
         guard.check("diag_gather", a11_sum, jnp.sum(aloc, axis=0),
                     mass=a11_mass, kind="transport", rows=w)
-        L11, Li11 = _potrf_inv(A11.local, precision)
+        L11, Li11 = _potrf_inv(A11.local, precision, plan=plan)
         d = jnp.tril(aloc)
         d = d + jnp.conj(jnp.tril(d, -1)).T
         cL = jnp.sum(L11, axis=0)
@@ -651,7 +653,8 @@ def abft_cholesky(A, nb=None, precision=None, comm_precision=None,
 # ---------------------------------------------------------------------
 
 def abft_qr(A, nb=None, precision=None, panel="classic",
-            comm_precision=None, timer=None, health=None, abft=True):
+            comm_precision=None, timer=None, health=None, abft=True,
+            plan=None):
     """Checksum-guarded blocked Householder QR (see module docstring).
 
     Same ``(packed, tau)`` geqrf contract as ``lapack.qr``; reached via
@@ -669,8 +672,8 @@ def abft_qr(A, nb=None, precision=None, panel="classic",
     from ..blas.level3 import _blocksize
     from ..lapack.lu import (_hi, _phase_hook, _update_cols_ge,
                              _update_cols_lt)
-    from ..lapack.qr import (_larft, _panel_qr, _panel_qr_tsqr, _panel_v,
-                             _record_qr_nb)
+    from ..lapack.qr import (_larft, _panel_qr_dispatch, _panel_qr_tsqr,
+                             _panel_v, _record_qr_nb)
     from .recovery import run_step
     from .health import attach_health
 
@@ -701,15 +704,16 @@ def abft_qr(A, nb=None, precision=None, panel="classic",
         ploc = panel_ss.local[:m - s, :e_up - s]
         guard.check("panel_gather", pan_sum, jnp.sum(ploc, axis=0),
                     mass=pan_mass, kind="transport", rows=m - s)
+        Tk = None
         if panel == "tsqr":
             Pf, tau = _panel_qr_tsqr(ploc[:, :nbw], r, precision)
         else:
-            Pf, tau = _panel_qr(ploc[:, :nbw])
+            Pf, tau, Tk = _panel_qr_dispatch(ploc[:, :nbw], plan)
         Pf, = apply_fault("compute", (Pf,))
         # factor invariant: panel = (I - V T V^H) [R; 0], so
         # colsum(panel) == colsum(R) - cV @ (T @ (V1^H R))
         V = _panel_v(Pf)
-        T = _larft(V, tau)
+        T = Tk if Tk is not None else _larft(V, tau)
         R11 = jnp.triu(Pf[:nbw])
         cV = jnp.sum(V, axis=0)
         rpred = (jnp.sum(R11, axis=0)

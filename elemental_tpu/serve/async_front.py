@@ -60,7 +60,6 @@ from __future__ import annotations
 
 import queue
 import threading
-import time
 
 from ..obs import metrics as _metrics
 from .admission import Deadline, reject_doc

@@ -106,17 +106,19 @@ class CostBreakdown:
     detail: dict               # trace geometry / closed-form site notes
     pivot_s: float = 0.0       # pivot/reflector serial-chain latency
     decode_s: float = 0.0      # comm_precision encode/decode passes
+    panel_impl_s: float = 0.0  # panel kernel-launch overhead (ISSUE 17)
 
     @property
     def total_s(self) -> float:
         return self.compute_s + self.latency_s + self.bandwidth_s \
-            + self.pivot_s + self.decode_s
+            + self.pivot_s + self.decode_s + self.panel_impl_s
 
     def to_doc(self) -> dict:
         return {"config": dict(self.config),
                 "total_s": self.total_s, "compute_s": self.compute_s,
                 "latency_s": self.latency_s, "bandwidth_s": self.bandwidth_s,
                 "pivot_s": self.pivot_s, "decode_s": self.decode_s,
+                "panel_impl_s": self.panel_impl_s,
                 "rounds": self.rounds, "comm_bytes": self.comm_bytes,
                 "prim_counts": dict(self.prim_counts),
                 "detail": dict(self.detail)}
@@ -186,6 +188,43 @@ def _pivot_seconds(op: str, ctx: TuneContext, config: dict,
     nb_r = blocksize_policy(config.get("nb"), ctx.grain, ext)
     steps = max(1, math.ceil(ext / nb_r))
     return (ext / r) * unit + steps * math.ceil(math.log2(r)) * unit
+
+
+#: interpret-mode slowdown of a pallas_call off-TPU: the fused panel
+#: kernels run through the Pallas interpreter there (an eval_jaxpr walk,
+#: orders of magnitude off compiled XLA), so 'auto' must never pick
+#: 'pallas' on cpu/gpu.  50x is a deliberately blunt ranking constant --
+#: any value >> 1 yields the same winner (pinned by tests/tune).
+INTERPRET_PENALTY = 50.0
+
+
+def _panel_impl_seconds(op: str, ctx: TuneContext, config: dict,
+                        machine: MachineModel) -> float:
+    """Panel kernel-LAUNCH overhead: the term that differentiates the
+    panel implementations (ISSUE 17).
+
+    The XLA panel ladder lowers to one data-dependent op chain PER
+    COLUMN of the sweep (``extent`` launches of pivot/scale/update for
+    lu, larfg steps for qr, per-block potrf/trinv pairs for cholesky)
+    -- launch-latency work the flop roofline cannot see.  The fused
+    Pallas kernel pays ONE launch per nb-panel (``steps`` total) and
+    runs the column chain VMEM-resident, so on TPU
+    ``panel_impl='auto'`` resolves to 'pallas'.  Off-TPU the kernels
+    only exist in interpret mode, priced at :data:`INTERPRET_PENALTY`
+    times the ladder -- 'auto' stays on 'xla' there.  Like
+    ``_pivot_seconds`` this is a ranking device, not a wall-clock
+    prediction; per-column units are one ``machine.latency_s``."""
+    if op not in ("lu", "cholesky", "qr"):
+        return 0.0
+    ext = max(ctx.extent, 1)
+    unit = machine.latency_s
+    impl = config.get("panel_impl") or "xla"
+    if impl != "pallas":
+        return ext * unit
+    if ctx.backend != "tpu":
+        return ext * unit * INTERPRET_PENALTY
+    nb_r = blocksize_policy(config.get("nb"), ctx.grain, ext)
+    return max(1, math.ceil(ext / nb_r)) * unit
 
 
 # ---------------------------------------------------------------------
@@ -341,6 +380,11 @@ def _traced_cost(op: str, config: dict, ctx: TuneContext, grid, dtype,
     # the "one a2a round vs k gather rounds" term is the trace itself.
     rp = config.get("redist_path") \
         if op in ("lu", "cholesky", "qr", "trsm", "herk") else None
+    # panel_impl deliberately does NOT reach _trace_stats: panels are
+    # replicated-local compute, so the traced comm schedule is identical
+    # under either implementation (the comm-invariance gate of
+    # tools/check.sh kernels pins exactly this) -- keeping it out of the
+    # memo key shares one trace across the panel_impl sweep.
     dims_t, nb_t, xo_t, lat_scale, byte_scale = _geometry(ctx, nb, xo, la)
     stats = _trace_stats(op, dims_t, nb_t, la, xo_t, grid, dtype, panel, rp)
     rounds = stats["rounds"] * lat_scale
@@ -353,6 +397,7 @@ def _traced_cost(op: str, config: dict, ctx: TuneContext, grid, dtype,
         bandwidth_s=wire_bytes / machine.bw_bytes_per_s,
         pivot_s=_pivot_seconds(op, ctx, config, machine),
         decode_s=decode_s,
+        panel_impl_s=_panel_impl_seconds(op, ctx, config, machine),
         rounds=rounds, comm_bytes=wire_bytes,
         prim_counts={k: t["count"] for k, t in stats["totals"].items()},
         detail={"trace_dims": list(dims_t), "trace_nb": nb_t,

@@ -149,6 +149,34 @@ def _with_redist_path(space: list, ctx: TuneContext, pinned: dict) -> list:
     return [{**cfg, "redist_path": rp} for cfg in space for rp in chosen]
 
 
+#: panel-kernel implementations of the factorization critical path
+#: (ISSUE 17): ``None``/'xla' = the status-quo op-ladder panels (the
+#: candidate-order tie-break leader), 'pallas' = the fused VMEM-resident
+#: kernels of :mod:`..kernels`.  Kept in sync with
+#: ``kernels.PANEL_IMPLS`` (pinned by tests/tune) but mirrored here as a
+#: literal so the registry stays import-light.
+PANEL_IMPLS = ("xla", "pallas")
+
+
+def _with_panel_impl(space: list, ctx: TuneContext, pinned: dict) -> list:
+    """Cross every candidate with the legal panel_impl values.
+
+    An explicitly pinned value (INCLUDING ``None``, the status-quo XLA
+    ladder every driver passes when the user did not opt in) freezes
+    the dimension; otherwise complex dtypes enumerate only 'xla' (the
+    fused kernels are real-only and the dispatch would gate them back
+    anyway) and real dtypes sweep both implementations -- the cost
+    model's launch-count term decides per backend (fused wins on TPU;
+    interpret-mode pallas never wins off-TPU)."""
+    if "panel_impl" in pinned:
+        chosen = (pinned["panel_impl"],)
+    elif "complex" in str(ctx.dtype):
+        chosen = ("xla",)
+    else:
+        chosen = PANEL_IMPLS
+    return [{**cfg, "panel_impl": pi} for cfg in space for pi in chosen]
+
+
 #: panel strategies of the pivoted/reflector factorizations (ISSUE 6):
 #: 'classic' = replicated column-at-a-time panel (the stability baseline),
 #: the alternative = communication-avoiding tree panel (CALU tournament
@@ -173,25 +201,32 @@ def _with_panels(space: list, ctx: TuneContext, pinned: dict,
 
 
 def _cholesky_space(ctx: TuneContext, pinned: dict) -> list:
-    return _with_redist_path(
-        _with_comm_precision(_factorization_space(ctx, pinned), ctx,
-                             pinned), ctx, pinned)
+    return _with_panel_impl(
+        _with_redist_path(
+            _with_comm_precision(_factorization_space(ctx, pinned), ctx,
+                                 pinned), ctx, pinned), ctx, pinned)
 
 
 def _lu_space(ctx: TuneContext, pinned: dict) -> list:
-    base = {k: v for k, v in pinned.items() if k not in ("panel",)}
-    return _with_redist_path(
-        _with_comm_precision(
-            _with_panels(_factorization_space(ctx, base), ctx, pinned,
-                         LU_PANELS), ctx, pinned), ctx, pinned)
+    base = {k: v for k, v in pinned.items()
+            if k not in ("panel", "panel_impl")}
+    return _with_panel_impl(
+        _with_redist_path(
+            _with_comm_precision(
+                _with_panels(_factorization_space(ctx, base), ctx, pinned,
+                             LU_PANELS), ctx, pinned), ctx, pinned),
+        ctx, pinned)
 
 
 def _qr_space(ctx: TuneContext, pinned: dict) -> list:
-    base = {k: v for k, v in pinned.items() if k != "panel"}
-    return _with_redist_path(
-        _with_comm_precision(
-            _with_panels(_nb_only_space(ctx, base), ctx, pinned, QR_PANELS),
-            ctx, pinned), ctx, pinned)
+    base = {k: v for k, v in pinned.items()
+            if k not in ("panel", "panel_impl")}
+    return _with_panel_impl(
+        _with_redist_path(
+            _with_comm_precision(
+                _with_panels(_nb_only_space(ctx, base), ctx, pinned,
+                             QR_PANELS), ctx, pinned), ctx, pinned),
+        ctx, pinned)
 
 
 def _nb_comm_space(ctx: TuneContext, pinned: dict) -> list:
@@ -245,12 +280,13 @@ class OpSpace:
 OPS = {
     "cholesky": OpSpace("cholesky",
                         ("nb", "lookahead", "crossover", "comm_precision",
-                         "redist_path"),
+                         "redist_path", "panel_impl"),
                         _cholesky_space),
     "lu": OpSpace("lu", ("nb", "lookahead", "crossover", "panel",
-                         "comm_precision", "redist_path"), _lu_space),
-    "qr": OpSpace("qr", ("nb", "panel", "comm_precision", "redist_path"),
-                  _qr_space),
+                         "comm_precision", "redist_path", "panel_impl"),
+                  _lu_space),
+    "qr": OpSpace("qr", ("nb", "panel", "comm_precision", "redist_path",
+                         "panel_impl"), _qr_space),
     "gemm": OpSpace("gemm", ("alg", "nb", "comm_precision", "redist_path"),
                     _gemm_space),
     "trsm": OpSpace("trsm", ("nb", "comm_precision", "redist_path"),

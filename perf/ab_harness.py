@@ -23,6 +23,11 @@ before/after pair).  Usage:
                                             #   ALL visible devices, plus
                                             #   comm_precision twins of the
                                             #   slice rows
+    python perf/ab_harness.py panel [M]     # ISSUE 17: the three panel
+                                            #   primitives, xla op-ladder vs
+                                            #   fused Pallas kernel, nb in
+                                            #   {64..2048} x dtype (panel
+                                            #   height M, dflt 16384/1024)
     python perf/ab_harness.py phases [lu|cholesky] [N NB]
                                             # per-step phase wall-clock as
                                             #   one phase_timings/v1 JSON line
@@ -181,36 +186,46 @@ def run_lu(n=None):
                                             jnp.float32))
     nb0 = 2048 if on_tpu else 128
 
-    # (name, lookahead, inners, nb, update_precision, crossover)
+    # (name, lookahead, inners, nb, update_precision, crossover, panel_impl)
     # xover=0 everywhere: this is the SINGLE-CHIP schedule harness (the
     # sequential path has no redistribution tail); the distributed LU
     # crossover A/B is `ab_harness.py lu-dist`, mirroring run_cholesky.
+    # inners rides the lu(inners=) kwarg (NOT a lu_mod._INNERS
+    # monkeypatch: since ISSUE 17 the resolved ladder flows through the
+    # PanelPlan, so patching the module alias would silently go stale).
     cases = [
         (f"classic        inners=(512,64) nb={nb0}", False, (512, 64), nb0,
-         None, 0),
+         None, 0, None),
         (f"look-ahead     inners=(512,64) nb={nb0}", True, (512, 64), nb0,
-         None, 0),
+         None, 0, None),
         (f"look-ahead     inners=(512,64) nb={nb0 // 2}", True, (512, 64),
-         nb0 // 2, None, 0),
+         nb0 // 2, None, 0, None),
         (f"look-ahead     inners=(512,64) nb={nb0 * 2}", True, (512, 64),
-         nb0 * 2, None, 0),
+         nb0 * 2, None, 0, None),
         (f"look-ahead     inners=(768,96) nb={nb0}", True, (768, 96), nb0,
-         None, 0),
+         None, 0, None),
         (f"look-ahead     inners=(1024,128) nb={nb0}", True, (1024, 128),
-         nb0, None, 0),
+         nb0, None, 0, None),
         (f"look-ahead     inners=(512,128,32) nb={nb0}", True, (512, 128, 32),
-         nb0, None, 0),
+         nb0, None, 0, None),
         (f"look-ahead+bf16upd inners=(512,64) nb={nb0}", True, (512, 64),
-         nb0, DEF, 0),
+         nb0, DEF, 0, None),
+        # panel_impl twin of the headline look-ahead row: equal
+        # nb/inners/schedule, pure fused-kernel A/B (ISSUE 17).  Off-TPU
+        # this times the interpret-mode kernel -- slower by construction,
+        # the row documents it; the VMEM gate may silently route huge
+        # panels back to xla (the resolved impl lands in bench.py
+        # provenance, not here).
+        (f"look-ahead     inners=(512,64) nb={nb0} panel=pallas", True,
+         (512, 64), nb0, None, 0, "pallas"),
     ]
 
-    orig_inners = lu_mod._INNERS
-    for name, la, inners, nb, upd, xover in cases:
-        lu_mod._INNERS = inners
+    for name, la, inners, nb, upd, xover, impl in cases:
         lufn = jax.jit(
-            lambda a, _nb=nb, _la=la, _u=upd, _x=xover: tuple(
-                el.lu(a, nb=_nb, precision=HI, update_precision=_u,
-                      lookahead=_la, crossover=_x)),
+            lambda a, _nb=nb, _la=la, _u=upd, _x=xover, _in=inners, _pi=impl:
+            tuple(el.lu(a, nb=_nb, precision=HI, update_precision=_u,
+                        lookahead=_la, crossover=_x, inners=_in,
+                        panel_impl=_pi)),
             donate_argnums=0)
 
         def step(A):
@@ -236,7 +251,6 @@ def run_lu(n=None):
             del LU, perm, mres
         report(name, (2 * n ** 3 / 3) / dt / 1e12, 0.5 * (r0 + r1), extra)
         del lufn
-    lu_mod._INNERS = orig_inners
 
 
 def run_lu_dist(n=None, cps=("bf16", "int8")):
@@ -321,30 +335,36 @@ def run_cholesky(n=None, cps=("bf16", "int8")):
     def wrap(a):
         return el.DistMatrix(a, (n, n), el.MC, el.MR, 0, 0, grid)
 
-    # (name, lookahead, nb, crossover, comm_precision)
+    # (name, lookahead, nb, crossover, comm_precision, panel_impl)
     cases = [
-        (f"classic        nb={nb0} xover=0", False, nb0, 0, None),
-        (f"look-ahead     nb={nb0} xover=0", True, nb0, 0, None),
-        (f"look-ahead     nb={nb0 // 2} xover=0", True, nb0 // 2, 0, None),
-        (f"look-ahead     nb={nb0 * 2} xover=0", True, nb0 * 2, 0, None),
+        (f"classic        nb={nb0} xover=0", False, nb0, 0, None, None),
+        (f"look-ahead     nb={nb0} xover=0", True, nb0, 0, None, None),
+        (f"look-ahead     nb={nb0 // 2} xover=0", True, nb0 // 2, 0, None,
+         None),
+        (f"look-ahead     nb={nb0 * 2} xover=0", True, nb0 * 2, 0, None,
+         None),
+        # panel_impl twin of the headline look-ahead row: equal
+        # nb/crossover, pure fused-_potrf_inv A/B (ISSUE 17)
+        (f"look-ahead     nb={nb0} xover=0 panel=pallas", True, nb0, 0,
+         None, "pallas"),
     ]
     if p > 1:
         for xo in (n // 8, n // 4, n // 2):
             cases.append((f"look-ahead     nb={nb0} xover={xo}", True, nb0,
-                          xo, None))
+                          xo, None, None))
         cases.append((f"classic        nb={nb0} xover={n // 4}",
-                      False, nb0, n // 4, None))
+                      False, nb0, n // 4, None, None))
         # wire-precision twins of the headline look-ahead row (pure
         # comm_precision A/B at equal nb/crossover)
         for cp in cps:
             cases.append((f"look-ahead     nb={nb0} xover=0 wire={cp}",
-                          True, nb0, 0, cp))
+                          True, nb0, 0, cp, None))
     print(f"grid {grid.height}x{grid.width}, n={n}", flush=True)
-    for name, la, nb, xo, cp in cases:
+    for name, la, nb, xo, cp, impl in cases:
         step = jax.jit(
-            lambda a, _nb=nb, _la=la, _xo=xo, _c=cp: el.cholesky(
+            lambda a, _nb=nb, _la=la, _xo=xo, _c=cp, _pi=impl: el.cholesky(
                 a, nb=_nb, precision=HI, lookahead=_la, crossover=_xo,
-                comm_precision=_c).local,
+                comm_precision=_c, panel_impl=_pi).local,
             donate_argnums=0)
         r0 = roofline()
         dt = timed(lambda: wrap(gen()), step)
@@ -424,6 +444,70 @@ def run_gemm(n=None, cps=("bf16", "int8")):
                       flush=True)
 
 
+def run_panel(n=None, dtypes=None):
+    """ISSUE 17 A/B: the three panel primitives, xla op-ladder vs fused
+    Pallas kernel, at matched inputs across the nb ladder x dtype --
+    roofline-bracketed like every other sweep.  On TPU the pallas rows
+    time the compiled Mosaic kernel; off-TPU they time the interpret-
+    mode twin (the CPU CI artifact, slower by construction -- the rows
+    exist so the gap is measured, not assumed).  Rows whose panel
+    exceeds the fused kernel's VMEM budget report ``skip (vmem)``:
+    the driver-level dispatch would route them back to xla."""
+    from elemental_tpu import kernels
+    qr_mod = importlib.import_module("elemental_tpu.lapack.qr")
+    on_tpu = jax.devices()[0].platform != "cpu"
+    m = int(n) if n else (16384 if on_tpu else 1024)
+    if dtypes is None:
+        dtypes = (jnp.float32,) if not jax.config.jax_enable_x64 \
+            else (jnp.float32, jnp.float64)
+    nbs = [nb for nb in (64, 128, 256, 512, 1024, 2048) if nb <= m]
+    inner = kernels.default_inners()[-1]
+    print(f"panel height m={m}, xla inner ladder {kernels.default_inners()}",
+          flush=True)
+
+    def sweep(prim, nb, dt, make, xla_fn, pal_fn, flops, copies):
+        for impl, fn in (("xla", xla_fn), ("pallas", pal_fn)):
+            name = f"{prim:5s} nb={nb:<5d} {jnp.dtype(dt).name:8s} {impl}"
+            if impl == "pallas" and not kernels.panel_fits(
+                    make().shape, dt, copies=copies):
+                print(f"{name:44s} skip (vmem: dispatch would route to xla)",
+                      flush=True)
+                continue
+            step = jax.jit(fn)
+            r0 = roofline()
+            dtime = timed(make, step)
+            r1 = roofline()
+            report(name, flops / dtime / 1e12, 0.5 * (r0 + r1))
+            del step
+
+    for dt in dtypes:
+        for nb in nbs:
+            key = jax.random.PRNGKey(nb)
+            P0 = jax.random.normal(key, (m, nb), dt)
+            G = jax.random.normal(key, (nb, nb), dt)
+            D0 = jnp.matmul(G, G.T, precision=HI) / nb \
+                + nb * jnp.eye(nb, dtype=dt)
+            # lu: the chunked panel ladder vs the fused kernel at the
+            # ladder's finest rung (what PanelPlan.pallas_inner selects)
+            sweep("lu", nb, dt, lambda _p=P0: _p,
+                  lambda p: lu_mod._panel_lu(p, nb, HI),
+                  lambda p: kernels.lu_panel(p, nb, HI, inner=inner),
+                  flops=m * nb * nb - nb ** 3 / 3, copies=3)
+            # chol: blocked potrf+inverse pair on the diagonal block
+            sweep("chol", nb, dt, lambda _d=D0: _d,
+                  lambda d: chol_mod._potrf_inv(d, HI),
+                  lambda d: kernels.potrf_inv(d, HI),
+                  flops=nb ** 3, copies=4)
+            # qr: larfg chain + larft build vs the fused single launch
+            def xla_qr(p):
+                packed, tau = qr_mod._panel_qr(p)
+                V = qr_mod._panel_v(packed)
+                return packed, tau, qr_mod._larft(V, tau)
+            sweep("qr", nb, dt, lambda _p=P0: _p, xla_qr,
+                  lambda p: kernels.qr_panel(p),
+                  flops=2 * nb * nb * (m - nb / 3), copies=4)
+
+
 def run_phases(*args):
     """Per-step phase wall-clock through the REAL driver (eager, PhaseTimer
     syncs at each boundary) -> one phase_timings/v1 JSON line.
@@ -455,8 +539,9 @@ def run_phases(*args):
         jax.block_until_ready(a)
         LU, perm = el.lu(A, nb=nb, precision=HI, lookahead=True, timer=t)
         jax.block_until_ready((LU.local, perm))
+        from elemental_tpu.kernels import default_inners
         meta = dict(driver="lu", flops=2 * n ** 3 / 3,
-                    inners=list(lu_mod._INNERS))
+                    inners=list(default_inners()))
     r = roofline()
     print(t.json(n=n, nb=nb, lookahead=True, roofline_tflops=round(r, 2),
                  device=jax.devices()[0].device_kind, **meta), flush=True)
@@ -488,5 +573,7 @@ if __name__ == "__main__":
         run_cholesky(*argv[1:2], cps=cps)
     elif mode == "gemm":
         run_gemm(*argv[1:2], cps=cps)
+    elif mode == "panel":
+        run_panel(*argv[1:2])
     else:
         run_phases(*argv[1:4])

@@ -1,0 +1,120 @@
+"""Pallas panel-kernel smoke CLI (ISSUE 17).
+
+    python -m perf.kernels smoke      # interpret-mode clean runs of all
+                                      #   three fused panel primitives
+                                      #   through the real drivers on the
+                                      #   1x1 and 2x2 grids
+
+``smoke`` is the cheap always-on gate ``tools/check.sh kernels`` runs:
+every driver factors a small matrix with ``panel_impl='pallas'`` (the
+fused kernels run under ``pallas_call(interpret=True)`` off-TPU), the
+factor residuals must sit inside the documented bounds, and the LU
+pivot sequence must be IDENTICAL to the XLA ladder's -- the bit-twin
+contract of ``kernels.lu_panel``.  Exits non-zero on any violation, so
+CI catches a broken kernel without waiting for the full pytest sweep
+(the heavyweight sweeps live in tests/kernels/, slow-marked).
+"""
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: residual ceilings of the smoke gate, generous multiples of the
+#: measured float32 residuals (~1e-7 at n=48; see tests/kernels/ for the
+#: tight per-primitive bounds on bigger sweeps)
+TOL = 5e-5
+
+
+def _bootstrap():
+    """CPU-friendly device setup BEFORE jax initializes (the comm_audit
+    convention): 8 virtual devices so the 2x2 grid exists off-hardware."""
+    if _REPO not in sys.path:
+        sys.path.insert(0, _REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _run_smoke() -> int:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import elemental_tpu as el
+
+    n, nb = 48, 8
+    rng = np.random.default_rng(17)
+    F = rng.normal(size=(n, n)).astype(np.float32)
+    S = (F @ F.T / n + n * np.eye(n)).astype(np.float32)
+    failures = []
+
+    def check(tag, resid, tol=TOL):
+        ok = resid < tol
+        print(f"{'ok ' if ok else 'FAIL'} {tag:40s} resid {resid:.2e}"
+              f" (tol {tol:.0e})", flush=True)
+        if not ok:
+            failures.append(tag)
+
+    for r, c in ((1, 1), (2, 2)):
+        grid = el.Grid(jax.devices()[: r * c], height=r)
+        A = el.from_global(jnp.asarray(F), el.MC, el.MR, grid=grid)
+        Aspd = el.from_global(jnp.asarray(S), el.MC, el.MR, grid=grid)
+
+        # lu: residual + pivot bit-identity vs the XLA ladder
+        LU, perm = el.lu(A, nb=nb, panel_impl="pallas")
+        lu_ = np.asarray(el.to_global(LU))
+        L = np.tril(lu_, -1) + np.eye(n, dtype=np.float32)
+        U = np.triu(lu_)
+        check(f"lu {r}x{c} pallas",
+              np.linalg.norm(L @ U - F[np.asarray(perm)])
+              / np.linalg.norm(F))
+        _, perm_x = el.lu(A, nb=nb, panel_impl="xla")
+        if not np.array_equal(np.asarray(perm), np.asarray(perm_x)):
+            print(f"FAIL lu {r}x{c} pivot sequence differs from xla",
+                  flush=True)
+            failures.append(f"lu {r}x{c} pivots")
+        else:
+            print(f"ok  lu {r}x{c} pivots identical to xla", flush=True)
+
+        # cholesky: factor residual of the fused _potrf_inv
+        Ld = el.cholesky(Aspd, nb=nb, panel_impl="pallas")
+        lg = np.asarray(el.to_global(Ld))
+        check(f"cholesky {r}x{c} pallas",
+              np.linalg.norm(lg @ lg.T - S) / np.linalg.norm(S))
+
+        # qr: reconstruction through the geqrf reflectors of the fused
+        # larfg+larft kernel (Q = H_0 ... H_{k-1}, R = triu(packed))
+        packed, tau = el.qr(A, nb=nb, panel_impl="pallas")
+        pg = np.asarray(el.to_global(packed))
+        tg = np.asarray(tau)
+        Qm = np.eye(n, dtype=np.float64)
+        for j in range(n):
+            v = np.zeros(n)
+            v[j] = 1.0
+            v[j + 1:] = pg[j + 1:, j]
+            Qm = Qm @ (np.eye(n) - tg[j] * np.outer(v, v))
+        Rm = np.triu(pg)
+        check(f"qr {r}x{c} pallas recon",
+              np.linalg.norm(Qm @ Rm - F) / np.linalg.norm(F))
+        check(f"qr {r}x{c} pallas ortho",
+              np.linalg.norm(Qm.T @ Qm - np.eye(n)) / np.sqrt(n))
+
+    if failures:
+        print(f"SMOKE FAILED: {failures}", flush=True)
+        return 1
+    print("kernels smoke OK", flush=True)
+    return 0
+
+
+def main(argv) -> int:
+    mode = argv[0] if argv else "smoke"
+    if mode != "smoke":
+        print(__doc__)
+        return 2
+    _bootstrap()
+    return _run_smoke()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

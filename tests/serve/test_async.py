@@ -7,7 +7,6 @@ worker thread or silently drops a future."""
 import threading
 
 import numpy as np
-import pytest
 
 from elemental_tpu.obs import metrics as _metrics
 from elemental_tpu.serve import (AsyncSolverService, SolverService,

@@ -45,6 +45,11 @@
 #                             #   pins (auto->slice on tall-skinny 2x4,
 #                             #   auto->dot on 1x1), and the slice
 #                             #   correctness/plan/knob test files
+#   tools/check.sh kernels    # fused-panel gate (ISSUE 17): pallas panel
+#                             #   smoke (interpret-mode lu/cholesky/qr on
+#                             #   1x1 + 2x2, pivot-identical LU), the
+#                             #   comm-plan byte-invariance sweep under
+#                             #   panel_impl='pallas', and tests/kernels
 #   tools/check.sh redist     # one-shot redistribution gate (ISSUE 12 +
 #                             #   13): plan-compiler unit + direct-vs-
 #                             #   chain bit-equivalence tests (incl.
@@ -267,6 +272,50 @@ PY
         tests/analysis/test_gemm_slice_plan.py \
         tests/tune/test_gemm_slice_knob.py \
         -q -m 'not slow' -p no:cacheprovider || rc=1
+fi
+
+if [ "$what" = "all" ] || [ "$what" = "kernels" ]; then
+    echo "== pallas panel-kernel smoke (interpret mode, 1x1 + 2x2, CPU-safe) =="
+    # clean pallas-panel runs of all three primitives through the real
+    # drivers: residual-bounded factors, LU pivots bit-identical to xla
+    JAX_PLATFORMS=cpu python -m perf.kernels smoke || rc=1
+    echo "== comm-plan invariance under panel_impl='pallas' =="
+    # panels are replicated-local compute: re-tracing every factorization
+    # variant with the fused kernels selected must yield BYTE-identical
+    # plan documents (and still pass the golden gate)
+    python - <<'PY' || rc=1
+import json, os, sys
+sys.path.insert(0, os.getcwd())
+from perf.comm_audit import GRIDS, _bootstrap, _grid, golden_path
+_bootstrap()
+from elemental_tpu import analysis as an
+from elemental_tpu.analysis import diff_docs, golden_doc
+from elemental_tpu.analysis.drivers import panel_impl_override
+fams = [d for d in an.driver_names()
+        if d.split("_")[0] in ("lu", "cholesky", "qr")
+        and not d.startswith("qr_lq")]
+bad = []
+for d in fams:
+    for grid in GRIDS:
+        base, _, _ = an.trace_driver(d, _grid(*grid))
+        base_doc = json.dumps(golden_doc(base), indent=1)
+        with panel_impl_override("pallas"):
+            plan, _, _ = an.trace_driver(d, _grid(*grid))
+        doc = golden_doc(plan)
+        if json.dumps(doc, indent=1) != base_doc:
+            bad.append(f"{d} {grid[0]}x{grid[1]}: plan bytes changed")
+        with open(golden_path(d, grid)) as f:
+            if diff_docs(json.load(f), doc):
+                bad.append(f"{d} {grid[0]}x{grid[1]}: golden diff")
+if bad:
+    print("COMM-PLAN INVARIANCE FAILURE under panel_impl='pallas':")
+    for b in bad:
+        print(f"  {b}")
+    sys.exit(1)
+print(f"comm-plan invariance ok ({len(fams)} variants x {len(GRIDS)} grids)")
+PY
+    echo "== kernels tests, full ladder incl. slow rungs =="
+    python -m pytest tests/kernels -q -p no:cacheprovider || rc=1
 fi
 
 if [ "$what" = "all" ] || [ "$what" = "serve" ]; then
