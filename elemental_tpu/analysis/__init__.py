@@ -1,4 +1,4 @@
-"""Static comm-plan analysis (ISSUE 3).
+"""Static comm-plan + memory-plan analysis (ISSUES 3, 18).
 
 Trace-time extraction of every driver's collective schedule straight from
 the closed jaxpr -- no device execution -- plus a rule-based linter and
@@ -6,24 +6,41 @@ the ``comm_plan/v1`` golden-snapshot machinery.  CLI:
 ``python -m perf.comm_audit {audit,diff,lint} ...``; generalizes the
 Python-call-level ``REDIST_COUNTS`` to "what does the traced program
 actually do".
+
+The memory twin (ISSUE 18) walks the SAME jaxprs for liveness instead of
+collectives: per-device peak live bytes, high-water timelines, a census
+of replicated materializations, ``memory_plan/v1`` goldens
+(``python -m perf.comm_audit {mem,mem-diff,mem-lint}``) and lint rules
+EL006-EL009 (budget / VMEM / donation / double-materialization).
 """
 from .jaxpr_walk import (CollectiveEvent, COLLECTIVE_PRIMS, collect_events,
                          count_pjit_calls, estimate_bytes,
                          find_loop_invariant_collectives)
 from .plan import SCHEMA, CommPlan, plan_from_parts, golden_doc, diff_docs
-from .lint import LintFinding, lint_plan
-from .drivers import (DRIVERS, LOOKAHEAD_PAIRS, CALU_PAIRS, COMMQ_PAIRS,
-                      COMMQ_MIN_BYTE_RATIO, DIRECT_PAIRS, DEFAULT_N,
-                      DEFAULT_NB, DEFAULT_XOVER, driver_names, trace_driver,
-                      trace_callable, storage_shape)
+from .lint import LintFinding, lint_plan, lint_memory
+from .memory import (MEM_SCHEMA, MemoryPlan, WalkStats, HighWater,
+                     PanelVmemCheck, PANEL_GATE_COPIES, analyze_jaxpr,
+                     memory_plan, trace_memory, replication_census,
+                     golden_mem_doc, diff_mem_docs, kernel_vmem_bytes,
+                     check_panel_vmem, panel_vmem_checks, panel_shapes)
+from .drivers import (DRIVERS, MEM_BUDGET_FACTORS, LOOKAHEAD_PAIRS,
+                      CALU_PAIRS, COMMQ_PAIRS, COMMQ_MIN_BYTE_RATIO,
+                      DIRECT_PAIRS, DEFAULT_N, DEFAULT_NB, DEFAULT_XOVER,
+                      driver_names, trace_driver, trace_callable,
+                      storage_shape)
 
 __all__ = [
     "CollectiveEvent", "COLLECTIVE_PRIMS", "collect_events",
     "count_pjit_calls", "estimate_bytes", "find_loop_invariant_collectives",
     "SCHEMA", "CommPlan", "plan_from_parts", "golden_doc", "diff_docs",
-    "LintFinding", "lint_plan",
-    "DRIVERS", "LOOKAHEAD_PAIRS", "CALU_PAIRS", "COMMQ_PAIRS",
-    "COMMQ_MIN_BYTE_RATIO", "DIRECT_PAIRS", "DEFAULT_N", "DEFAULT_NB",
-    "DEFAULT_XOVER", "driver_names", "trace_driver", "trace_callable",
-    "storage_shape",
+    "LintFinding", "lint_plan", "lint_memory",
+    "MEM_SCHEMA", "MemoryPlan", "WalkStats", "HighWater", "PanelVmemCheck",
+    "PANEL_GATE_COPIES", "analyze_jaxpr", "memory_plan", "trace_memory",
+    "replication_census", "golden_mem_doc", "diff_mem_docs",
+    "kernel_vmem_bytes", "check_panel_vmem", "panel_vmem_checks",
+    "panel_shapes",
+    "DRIVERS", "MEM_BUDGET_FACTORS", "LOOKAHEAD_PAIRS", "CALU_PAIRS",
+    "COMMQ_PAIRS", "COMMQ_MIN_BYTE_RATIO", "DIRECT_PAIRS", "DEFAULT_N",
+    "DEFAULT_NB", "DEFAULT_XOVER", "driver_names", "trace_driver",
+    "trace_callable", "storage_shape",
 ]

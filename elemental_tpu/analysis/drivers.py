@@ -68,6 +68,10 @@ class DriverSpec:
     name: str
     build: callable          # (grid, n, nb, dtype) -> (fn, args, meta)
     allow_bf16: bool = False
+    #: lint EL006 budget: peak live bytes may not exceed this multiple of
+    #: the driver's per-device input+output residency (see
+    #: ``MEM_BUDGET_FACTORS`` for the declared exceptions)
+    mem_budget_factor: float = 4.0
 
 
 def _gemm_spec(alg, variant="", redist_path=None):
@@ -366,8 +370,30 @@ def _registry() -> dict:
         # old eager bridge) and its golden pins the fused gather rounds
         _redist_circ_spec(),
     ]
-    return {s.name: s for s in specs}
+    out = {}
+    for s in specs:
+        factor = MEM_BUDGET_FACTORS.get(s.name)
+        if factor is not None:
+            s = dataclasses.replace(s, mem_budget_factor=factor)
+        out[s.name] = s
+    return out
 
+
+#: per-driver EL006 overrides above the 4.0x default, each a DECLARED
+#: memory cost the variant is known to pay (measured on 1x1+2x2, pinned
+#: by the memory_plan goldens + tests/analysis/test_mem_lint.py):
+#: the slice gather one-shots whole operand slabs, `[CIRC,CIRC]` and
+#: `[MD,*]` forms concentrate the operand on few devices, and the
+#: direct one-shot plans stage full send+recv buffers at once.
+MEM_BUDGET_FACTORS = {
+    "gemm_slice": 6.5,        # one-shot row/col slab gathers (by design)
+    "gemm_dot_direct": 5.0,   # replicated-form staging, direct plans
+    "herk_direct": 6.0,
+    "qr_lq_direct": 5.0,
+    "redist_circ": 6.5,       # root holds the FULL gathered operand
+    "redist_md": 7.5,         # lcm-stride staging buffers
+    "redist_md_direct": 7.5,
+}
 
 DRIVERS = _registry()
 

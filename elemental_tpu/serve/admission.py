@@ -45,8 +45,10 @@ REJECT_SCHEMA = "serve_reject/v1"
 #: reject reasons (pinned by tests/serve).  'shutdown' (ISSUE 11) marks
 #: requests flushed by ``SolverService.shutdown`` -- queued work that was
 #: NOT executed gets this structured reject instead of being dropped.
+#: 'memory_pressure' (ISSUE 18): the bucket's statically derived peak
+#: bytes at max_batch do not fit the configured per-device HBM.
 REJECT_REASONS = ("queue_pressure", "deadline_expired", "breaker_open",
-                  "bad_request", "shutdown")
+                  "bad_request", "shutdown", "memory_pressure")
 
 #: cold-start throughput assumption for the flops-based cost seed,
 #: flop/s.  Deliberately modest (CPU-class): a cold service sheds
@@ -187,13 +189,59 @@ class AdmissionController:
 
     def __init__(self, *, shed: bool = True, max_batch: int = 8,
                  flops_per_s: float = COLD_FLOPS_PER_S,
-                 clock=time.monotonic):
+                 clock=time.monotonic, hbm_bytes: float | None = None):
         self.shed = bool(shed)
         self.max_batch = max(int(max_batch), 1)
         self.flops_per_s = float(flops_per_s)
         self.clock = clock
+        #: per-device HBM budget for the memory-pressure check (ISSUE 18).
+        #: None = the backend default from the tuner's machine table,
+        #: resolved lazily (jax must not initialize at import time)
+        self.hbm_bytes = None if hbm_bytes is None else float(hbm_bytes)
         self._ids = itertools.count()
         self._ewma: dict = {}            # bucket.key() -> seconds per batch
+        self._peak_memo: dict = {}       # bucket.key() -> peak bytes | None
+
+    # ---- memory pressure (ISSUE 18) ---------------------------------
+    def _hbm_budget(self) -> float:
+        if self.hbm_bytes is None:
+            import jax
+            from ..tune.cost_model import machine_for
+            self.hbm_bytes = float(
+                machine_for(jax.default_backend()).hbm_bytes)
+        return self.hbm_bytes
+
+    def bucket_peak_bytes(self, bucket: Bucket) -> float | None:
+        """Statically derived peak live bytes of ONE max_batch batch of
+        this bucket (the executor's vmapped kernel, liveness-walked --
+        no device execution).  Memoized per bucket; None when the
+        abstract trace is unavailable (never a reason to shed)."""
+        key = bucket.key()
+        if key in self._peak_memo:
+            return self._peak_memo[key]
+        try:                    # lazy: executor imports Bucket from here
+            from .executor import batch_peak_bytes
+            peak = batch_peak_bytes(bucket, self.max_batch)
+        except Exception:
+            peak = None
+        self._peak_memo[key] = peak
+        return peak
+
+    def memory_pressure(self, bucket: Bucket):
+        """(peak bytes, budget) when the bucket CANNOT fit, else None.
+
+        The double-buffered worker keeps two batches resident (one on
+        device, one staging), so the shed threshold is 2x the single
+        batch peak against the per-device HBM budget."""
+        if not self.shed:
+            return None
+        peak = self.bucket_peak_bytes(bucket)
+        if peak is None:
+            return None
+        budget = self._hbm_budget()
+        if 2.0 * peak > budget:
+            return peak, budget
+        return None
 
     # ---- cost estimation --------------------------------------------
     def estimate_batch_s(self, bucket: Bucket) -> float:
@@ -251,6 +299,14 @@ class AdmissionController:
                              m=A.shape[0] if op == "lstsq" else None)
         if callable(queue_depth):
             queue_depth = int(queue_depth(bucket))
+        pressure = self.memory_pressure(bucket)
+        if pressure is not None:
+            peak, budget = pressure
+            return reject_doc(
+                "memory_pressure", bucket=bucket, queue_depth=queue_depth,
+                deadline=deadline,
+                detail=f"static peak {int(peak)} B/batch x2 (double "
+                       f"buffer) exceeds the {int(budget)} B HBM budget")
         if deadline is not None:
             if deadline.expired():
                 return reject_doc("deadline_expired", bucket=bucket,

@@ -149,6 +149,31 @@ def _kernel(op: str):
     return solve
 
 
+#: memoized static batch peaks: (op, dims, nrhs, dtype, slots) -> bytes
+_PEAK_MEMO: dict = {}
+
+
+def batch_peak_bytes(bucket: Bucket, slots: int) -> int:
+    """Statically derived peak live bytes of ONE ``slots``-wide batch of
+    this bucket: the SAME vmapped solve kernel the executor compiles,
+    abstractly traced and liveness-walked (``analysis.memory``) -- no
+    device execution, no compile.  Feeds the admission controller's
+    memory-pressure shed decision (ISSUE 18)."""
+    m, n = _bucket_dims(bucket)
+    key = (bucket.op, m, n, bucket.nrhs, str(bucket.dtype), int(slots))
+    hit = _PEAK_MEMO.get(key)
+    if hit is not None:
+        return hit
+    import jax
+    from ..analysis.memory import analyze_jaxpr
+    a = jax.ShapeDtypeStruct((int(slots), m, n), bucket.dtype)
+    b = jax.ShapeDtypeStruct((int(slots), m, bucket.nrhs), bucket.dtype)
+    closed = jax.make_jaxpr(jax.vmap(_kernel(bucket.op)))(a, b)
+    peak = analyze_jaxpr(closed, grid_size=1).peak_bytes
+    _PEAK_MEMO[key] = peak
+    return peak
+
+
 #: memoized tuner-provenance tokens: (cache_dir, driver_op, dims, dtype,
 #: backend) -> (tune-cache epoch, token).  Recomputed only when the
 #: in-process tuning-cache write generation moves (ISSUE 14 satellite:

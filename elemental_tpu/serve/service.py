@@ -60,6 +60,7 @@ class SolverService:
                  degrade_pressure: float = DEGRADE_PRESSURE,
                  escalate_nb: int | None = None, tol_factor: float = 1.0,
                  flops_per_s: float | None = None,
+                 hbm_bytes: float | None = None,
                  clock=time.monotonic, sleep=None):
         self.grid = grid
         self.max_batch = max(int(max_batch), 1)
@@ -74,6 +75,8 @@ class SolverService:
         self.clock = clock
         self._sleep = sleep if sleep is not None else time.sleep
         kw = {} if flops_per_s is None else {"flops_per_s": flops_per_s}
+        if hbm_bytes is not None:
+            kw["hbm_bytes"] = hbm_bytes
         self.admission = AdmissionController(
             shed=shed, max_batch=self.max_batch, clock=clock, **kw)
         self.executor = Executor(clock=clock)

@@ -77,15 +77,19 @@ class MachineModel:
     #: vector-unit (HBM-stream) bandwidth pricing the quantize/dequantize
     #: passes of the comm_precision path -- roughly 10x the wire
     decode_bw_bytes_per_s: float = 4.0e11
+    #: per-device HBM capacity (ISSUE 18): candidates whose statically
+    #: derived peak live bytes exceed it are PRUNED by the resolver, not
+    #: merely penalized -- an OOM is not a slow configuration
+    hbm_bytes: float = 16 * 2**30
 
 
 MACHINES = {
     "tpu": MachineModel("tpu", latency_s=2e-6, bw_bytes_per_s=4.5e10,
-                        peak_flops=3.0e13),
+                        peak_flops=3.0e13, hbm_bytes=16 * 2**30),
     "gpu": MachineModel("gpu", latency_s=3e-6, bw_bytes_per_s=3.0e10,
-                        peak_flops=2.0e13),
+                        peak_flops=2.0e13, hbm_bytes=80 * 2**30),
     "cpu": MachineModel("cpu", latency_s=5e-6, bw_bytes_per_s=1.0e10,
-                        peak_flops=2.0e11),
+                        peak_flops=2.0e11, hbm_bytes=64 * 2**30),
 }
 
 
@@ -107,6 +111,8 @@ class CostBreakdown:
     pivot_s: float = 0.0       # pivot/reflector serial-chain latency
     decode_s: float = 0.0      # comm_precision encode/decode passes
     panel_impl_s: float = 0.0  # panel kernel-launch overhead (ISSUE 17)
+    peak_bytes: float = 0.0    # statically derived per-device peak live
+    pruned: bool = False       # peak_bytes > machine.hbm_bytes (OOM risk)
 
     @property
     def total_s(self) -> float:
@@ -120,6 +126,7 @@ class CostBreakdown:
                 "pivot_s": self.pivot_s, "decode_s": self.decode_s,
                 "panel_impl_s": self.panel_impl_s,
                 "rounds": self.rounds, "comm_bytes": self.comm_bytes,
+                "peak_bytes": self.peak_bytes, "pruned": self.pruned,
                 "prim_counts": dict(self.prim_counts),
                 "detail": dict(self.detail)}
 
@@ -342,8 +349,16 @@ def _trace_stats(op: str, dims_t, nb_t: int, la, xo_t, grid, dtype,
     else:
         raise KeyError(f"no trace builder for op {op!r}")
 
-    plan, _, _ = trace_callable(fn, args, name=f"tune:{op}", grid=grid)
+    plan, closed, log = trace_callable(fn, args, name=f"tune:{op}",
+                                       grid=grid)
     totals = plan.totals()
+    # the memory term (ISSUE 18) rides the SAME abstract trace: the
+    # liveness walk + replicated census of analysis.memory, at the trace
+    # geometry (extrapolated with byte_scale by the caller, like bytes)
+    from ..analysis.memory import analyze_jaxpr, replication_census
+    p = max(grid.height * grid.width, 1)
+    walk = analyze_jaxpr(closed, grid_size=p)
+    census = replication_census(log, (grid.height, grid.width))
     # latency rounds count only REAL collectives: a collective over a
     # size-1 axis (1x1 grids, degenerate sub-axes) is elided by XLA.
     # prim_counts keep the raw per-primitive totals -- those are what the
@@ -351,7 +366,9 @@ def _trace_stats(op: str, dims_t, nb_t: int, la, xo_t, grid, dtype,
     stats = {"totals": totals,
              "rounds": sum(ev.count for ev in plan.events
                            if ev.axis_size > 1),
-             "bytes": sum(t["bytes"] for t in totals.values())}
+             "bytes": sum(t["bytes"] for t in totals.values()),
+             "peak": walk.peak_bytes + walk.nonstatic_peak_bytes
+             + census["max_extra_bytes"]}
     _TRACE_MEMO[key] = stats
     return stats
 
@@ -390,6 +407,9 @@ def _traced_cost(op: str, config: dict, ctx: TuneContext, grid, dtype,
     rounds = stats["rounds"] * lat_scale
     cbytes = stats["bytes"] * byte_scale
     wire_bytes, decode_s = _wire_terms(cbytes, cpm, machine)
+    # resident bytes extrapolate with the matrix AREA like wire bytes
+    # (the peak is operand-slab dominated, not schedule dominated)
+    peak = stats["peak"] * byte_scale
     return CostBreakdown(
         config=dict(config),
         compute_s=_compute_seconds(op, ctx, nb, machine),
@@ -399,6 +419,7 @@ def _traced_cost(op: str, config: dict, ctx: TuneContext, grid, dtype,
         decode_s=decode_s,
         panel_impl_s=_panel_impl_seconds(op, ctx, config, machine),
         rounds=rounds, comm_bytes=wire_bytes,
+        peak_bytes=peak, pruned=peak > machine.hbm_bytes,
         prim_counts={k: t["count"] for k, t in stats["totals"].items()},
         detail={"trace_dims": list(dims_t), "trace_nb": nb_t,
                 "trace_crossover": xo_t, "lat_scale": round(lat_scale, 3),
@@ -539,6 +560,14 @@ def _gemm_cost(config: dict, ctx: TuneContext, itemsize: int,
     wire_ag, decode_s = _wire_terms(ag_bytes,
                                     "bf16" if cpm else None, machine)
     wire_bytes = (cbytes - ag_bytes) + wire_ag
+    # closed-form peak (ISSUE 18): the three operands sharded over p,
+    # plus the largest single gathered/reduced buffer a site stages (a
+    # collective's received bytes land in one live replicated form) --
+    # the same ranking-device spirit as the rest of the model, pinned
+    # within 2x of the abstract-trace walk by tests/tune
+    p_dev = max(r * c, 1)
+    base = (m * k + k * n + m * n) * itemsize / p_dev
+    peak = base + max((b for _, _, b in sites), default=0)
     return CostBreakdown(
         config=dict(config),
         compute_s=_compute_seconds("gemm", ctx, nb, machine,
@@ -547,6 +576,7 @@ def _gemm_cost(config: dict, ctx: TuneContext, itemsize: int,
         bandwidth_s=wire_bytes / machine.bw_bytes_per_s,
         decode_s=decode_s,
         rounds=rounds, comm_bytes=wire_bytes, prim_counts=counts,
+        peak_bytes=peak, pruned=peak > machine.hbm_bytes,
         detail={"sites": [{"site": t, "prim": p, "bytes": b}
                           for t, p, b in sites],
                 "comm_precision": cpm, "redist_path": rp})

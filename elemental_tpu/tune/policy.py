@@ -145,8 +145,13 @@ def resolve(op: str, *, gshape, dtype, grid, requested: dict,
                                           dtype=jnp.dtype(dtype),
                                           machine=machine)
                   for cfg in cands]
+        # memory-pruned candidates (statically derived peak over the
+        # backend HBM, ISSUE 18) sort behind every fitting one: an OOM
+        # is not a slow configuration.  All-pruned still resolves (the
+        # least-bad candidate) so tiny dev grids never hard-fail.
         order = sorted(range(len(scored)),
-                       key=lambda i: (scored[i].total_s, i))
+                       key=lambda i: (scored[i].pruned,
+                                      scored[i].total_s, i))
         best = scored[order[0]]
         res = Resolution(op=op, key=key, source="cost_model",
                          config={k: best.config[k] for k in auto_keys
@@ -190,5 +195,6 @@ def explain(op: str, *, gshape, dtype, grid, requested: dict | None = None,
     scored = sorted((cost_model.score_config(op, cfg, ctx=ctx, grid=grid,
                                              dtype=jnp.dtype(dtype),
                                              machine=machine)
-                     for cfg in cands), key=lambda b: b.total_s)
+                     for cfg in cands),
+                    key=lambda b: (b.pruned, b.total_s))
     return ctx, scored

@@ -1,0 +1,162 @@
+"""While-loop handling of the jaxpr walkers, in isolation (ISSUE 18
+satellite).
+
+A ``while`` body has NO static trip count, so everything inside it is
+unquantifiable at trace time.  The contract, pinned here end to end:
+
+* the collective walker marks while-body events ``static=False`` and
+  counts the body ONCE (never a guessed multiplier), and the plan's
+  ``static`` flag -- part of every golden document -- flips to False;
+* the MEMORY walker excludes while-body allocations from the pinned
+  golden byte totals (``peak_bytes`` / ``walk_peak_bytes``) and routes
+  them to ``nonstatic_peak_bytes`` instead;
+* lint still SEES them: EL006 folds ``nonstatic_peak_bytes`` into the
+  budget check, so non-static growth surfaces as a finding even though
+  it never moves a golden number.
+
+Previously this behavior was only crossed incidentally by driver traces;
+these tests isolate it on minimal jaxprs so a walker refactor cannot
+silently change the accounting.
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from elemental_tpu.core.compat import shard_map
+
+from elemental_tpu import Grid
+from elemental_tpu import analysis as an
+from elemental_tpu.analysis.jaxpr_walk import collect_events
+from elemental_tpu.analysis.lint import rule_mem_budget
+from elemental_tpu.analysis.plan import plan_from_parts
+
+
+@pytest.fixture(scope="module")
+def g22():
+    return Grid(jax.devices()[:4], height=2)
+
+
+def _smap(g, fn):
+    return shard_map(fn, mesh=g.mesh, in_specs=P(),
+                     out_specs=P(), check_vma=False)
+
+
+def _while_program(g22):
+    """A while body that both ALLOCATES (a fresh (8, 8) intermediate per
+    iteration) and COMMUNICATES (one psum), behind a static prologue."""
+    def body(x):
+        pre = x * 2.0                        # static allocation
+
+        def cond(c):
+            return c[0] < 3
+
+        def step(c):
+            grown = c[1] @ c[1].T            # non-static allocation
+            return (c[0] + 1, grown + lax.psum(c[1], "mr"))
+
+        return lax.while_loop(cond, step, (0, pre))[1]
+
+    return jax.make_jaxpr(_smap(g22, body))(
+        jax.ShapeDtypeStruct((8, 8), jnp.float32))
+
+
+def test_while_events_count_once_not_multiplied(g22):
+    closed = _while_program(g22)
+    evs = collect_events(closed)
+    psums = [ev for ev in evs if ev.prim == "psum"]
+    assert len(psums) == 1
+    assert psums[0].count == 1, "while bodies must never guess a trip count"
+    assert not psums[0].static
+    assert any(p.startswith("while") for p in psums[0].path)
+
+
+def test_while_flips_plan_static_flag(g22):
+    closed = _while_program(g22)
+    plan = plan_from_parts("toy_while", (2, 2), {"n": 8},
+                           collect_events(closed), ())
+    assert plan.static is False
+    assert plan.to_doc(events=False)["static"] is False
+    # the events still participate in totals at their once-counted size:
+    # the golden doc records them, flagged, rather than hiding them
+    assert plan.totals()["psum"]["count"] == 1
+
+
+def test_scan_stays_static_for_contrast(g22):
+    """The sibling construct WITH a static trip count keeps static=True
+    and multiplies -- the walker distinguishes the two loop prims."""
+    def body(x):
+        def step(c, _):
+            return c + lax.psum(c, "mr"), None
+        return lax.scan(step, x, None, length=4)[0]
+
+    closed = jax.make_jaxpr(_smap(g22, body))(
+        jax.ShapeDtypeStruct((8, 8), jnp.float32))
+    (ev,) = collect_events(closed)
+    assert ev.static and ev.count == 4
+
+
+# ---------------------------------------------------------------------
+# memory walker: excluded from goldens, surfaced in lint
+# ---------------------------------------------------------------------
+
+def test_while_allocations_excluded_from_golden_peak(g22):
+    """Body-internal intermediates are NON-static (the loop may run any
+    number of times); the while's carry OUTPUTS are static (they exist
+    after the loop regardless).  Pin the split by blowing up only the
+    body's scratch: the golden peak must not move, the non-static
+    component must."""
+    def make(scratch):
+        def body(x):
+            pre = x * 2.0
+
+            def cond(c):
+                return c[0] < 3
+
+            def step(c):
+                big = jnp.zeros((scratch, scratch), jnp.float32)
+                return (c[0] + 1,
+                        c[1] + lax.psum(c[1], "mr") + big[:8, :8])
+
+            return lax.while_loop(cond, step, (0, pre))[1]
+
+        closed = jax.make_jaxpr(_smap(g22, body))(
+            jax.ShapeDtypeStruct((8, 8), jnp.float32))
+        return an.analyze_jaxpr(closed, grid_size=4)
+
+    small, big = make(8), make(64)
+    assert small.nonstatic_peak_bytes > 0
+    assert not small.static
+    assert big.peak_bytes == small.peak_bytes, \
+        "body scratch leaked into the pinned golden peak"
+    assert big.nonstatic_peak_bytes > small.nonstatic_peak_bytes
+
+
+def test_while_memory_doc_carries_nonstatic_field(g22):
+    closed = _while_program(g22)
+    mplan = an.memory_plan("toy_while", (2, 2), {"n": 8}, closed)
+    doc = mplan.to_doc()
+    assert doc["static"] is False
+    assert doc["nonstatic_peak_bytes"] == mplan.stats.nonstatic_peak_bytes
+    assert doc["nonstatic_peak_bytes"] > 0
+    assert doc["walk_peak_bytes"] == mplan.stats.peak_bytes
+
+
+def test_while_allocations_surface_in_el006(g22):
+    """EL006 folds the non-static high water into the budget check: a
+    budget the static peak fits but static+nonstatic exceeds FIRES, and
+    the finding names the while-body component."""
+    closed = _while_program(g22)
+    mplan = an.memory_plan("toy_while", (2, 2), {"n": 8}, closed)
+    static_peak = mplan.peak_bytes
+    ns = mplan.stats.nonstatic_peak_bytes
+    base = max(mplan.stats.args_bytes + mplan.stats.outs_bytes, 1)
+    # budget strictly between the static peak and the folded total
+    factor = (static_peak + ns / 2) / base
+    assert static_peak <= factor * base < static_peak + ns
+    (f,) = rule_mem_budget(mplan, factor)
+    assert f.rule == "EL006"
+    assert "NO static trip count" in f.message
+    # while a budget covering the folded total stays quiet
+    assert rule_mem_budget(mplan, (static_peak + ns) * 1.01 / base) == []

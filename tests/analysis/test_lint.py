@@ -65,6 +65,23 @@ def test_round_trip_fix_hint_quotes_the_direct_plan(g22):
     assert "round(s)" in hint and "vs the chain's" in hint
 
 
+def test_round_trip_fix_hint_quotes_the_slice_plan(g22):
+    """ISSUE 18: on a slice-legal src->dst pair the hint ALSO quotes the
+    compile_slice_plan sub-range rewrite, with its compiled kind/cost --
+    pay for the block you touch, not the matrix."""
+    from elemental_tpu.redist.plan import compile_slice_plan
+    findings = _lint(g22, _toy(g22, round_trip=True))
+    hint = next(f.fix_hint for f in findings if f.rule == "EL002")
+    assert "compile_slice_plan" in hint
+    assert f"rows=(0, {N // 2})" in hint
+    assert "pay for the block you touch" in hint
+    # the quoted numbers are the COMPILED slice plan's, not boilerplate
+    splan = compile_slice_plan((MC, MR), (VC, STAR), (N, N), (2, 2),
+                               rows=(0, N // 2))
+    assert f"'{splan.kind}'" in hint
+    assert f"{splan.rounds} round(s)" in hint
+
+
 def test_round_trip_removed_passes(g22):
     assert _lint(g22, _toy(g22, round_trip=False)) == []
 

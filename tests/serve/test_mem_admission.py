@@ -1,0 +1,91 @@
+"""Memory-aware admission (ISSUE 18): the shed decision consults
+statically-derived peak bytes.
+
+``bucket_peak_bytes`` liveness-walks the executor's vmapped kernel for
+one max_batch batch -- no device execution -- and ``admit`` sheds with a
+structured ``memory_pressure`` reject when TWO such batches (the
+double-buffer depth) cannot fit the per-device HBM budget.  An
+unavailable estimate is never a reason to shed."""
+import numpy as np
+
+from elemental_tpu.serve import AdmissionController, make_bucket
+
+from .conftest import diag_dom
+
+
+def _request(rng, n=12):
+    return diag_dom(rng, n), rng.normal(size=(n, 2))
+
+
+def test_bucket_peak_bytes_positive_and_memoized():
+    ctrl = AdmissionController()
+    b = make_bucket("lu", 12, 2, np.float64)
+    peak = ctrl.bucket_peak_bytes(b)
+    assert peak is not None and peak > 0
+    # at least the two operand buffers of one batch must be resident
+    operands = ctrl.max_batch * (b.n * b.n + b.n * b.nrhs) * 8
+    assert peak >= operands
+    assert ctrl.bucket_peak_bytes(b) is peak or \
+        ctrl.bucket_peak_bytes(b) == peak
+    assert b.key() in ctrl._peak_memo
+
+
+def test_default_budget_admits():
+    rng = np.random.default_rng(0)
+    ctrl = AdmissionController()
+    req = ctrl.admit("lu", *_request(rng))
+    assert not isinstance(req, dict), req
+
+
+def test_tiny_hbm_sheds_with_structured_reject():
+    rng = np.random.default_rng(1)
+    ctrl = AdmissionController(hbm_bytes=1024)
+    doc = ctrl.admit("lu", *_request(rng))
+    assert isinstance(doc, dict)
+    assert doc["reason"] == "memory_pressure"
+    assert doc["bucket"] == "lu__b16x2__float64"
+    assert "double buffer" in doc["detail"]
+    assert "HBM budget" in doc["detail"]
+
+
+def test_threshold_is_double_buffered():
+    """The shed line is 2x one batch's static peak: a budget between
+    1x and 2x must shed, a budget above 2x must admit."""
+    rng = np.random.default_rng(2)
+    probe = AdmissionController()
+    peak = probe.bucket_peak_bytes(make_bucket("lu", 12, 2, np.float64))
+    assert peak is not None
+    shed = AdmissionController(hbm_bytes=1.5 * peak)
+    assert isinstance(shed.admit("lu", *_request(rng)), dict)
+    ok = AdmissionController(hbm_bytes=2.5 * peak)
+    assert not isinstance(ok.admit("lu", *_request(rng)), dict)
+
+
+def test_shed_false_disables_memory_pressure():
+    rng = np.random.default_rng(3)
+    ctrl = AdmissionController(shed=False, hbm_bytes=1024)
+    assert ctrl.memory_pressure(make_bucket("lu", 12, 2, np.float64)) is None
+    req = ctrl.admit("lu", *_request(rng))
+    assert not isinstance(req, dict)
+
+
+def test_unavailable_estimate_never_sheds(monkeypatch):
+    """If the abstract trace fails, peak is None and admission proceeds:
+    degraded observability must not become an outage."""
+    rng = np.random.default_rng(4)
+    ctrl = AdmissionController(hbm_bytes=1024)
+    monkeypatch.setattr("elemental_tpu.serve.executor.batch_peak_bytes",
+                        lambda bucket, slots: (_ for _ in ()).throw(
+                            RuntimeError("trace backend down")))
+    req = ctrl.admit("lu", *_request(rng))
+    assert not isinstance(req, dict)
+    assert ctrl._peak_memo[req.bucket.key()] is None
+
+
+def test_service_threads_hbm_budget():
+    """SolverService(hbm_bytes=...) reaches the admission controller."""
+    from elemental_tpu.serve import SolverService
+    svc = SolverService(hbm_bytes=1024)
+    assert svc.admission.hbm_bytes == 1024
+    default = SolverService()
+    assert default.admission.hbm_bytes is None

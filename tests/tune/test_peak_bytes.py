@@ -1,0 +1,120 @@
+"""Memory-aware tuning (ISSUE 18): the cost model's ``peak_bytes`` term.
+
+Every scored candidate now carries the statically-derived per-device peak
+(traced ops: the liveness walk + replication census at the probe
+geometry, byte-scaled to the request; gemm: closed form).  Candidates
+whose peak exceeds the machine's HBM are PRUNED -- ranked behind every
+fitting candidate regardless of modeled time -- because an OOM is not a
+slow configuration.  All-pruned still resolves (best effort beats a
+crash).
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from elemental_tpu import Grid
+from elemental_tpu.tune import TuneContext, policy
+from elemental_tpu.tune import cost_model as cm
+
+
+def _grid(r, c):
+    return Grid(jax.devices()[: r * c], height=r)
+
+
+def _ctx(op, dims, grid):
+    return TuneContext(op, dims, "float32",
+                       (grid.height, grid.width), "cpu")
+
+
+def _tiny_machine(hbm=1024.0):
+    return dataclasses.replace(cm.machine_for("cpu"), hbm_bytes=hbm)
+
+
+def test_traced_breakdown_carries_peak_bytes():
+    g = _grid(2, 2)
+    b = cm.score_config("cholesky", {"nb": 16, "lookahead": False,
+                                     "crossover": 0},
+                        ctx=_ctx("cholesky", (64, 64), g),
+                        grid=g, dtype=jnp.float32)
+    assert b.peak_bytes > 0
+    assert not b.pruned, "a 64x64 f32 factor fits 64 GiB of HBM"
+    doc = b.to_doc()
+    assert doc["peak_bytes"] == b.peak_bytes
+    assert doc["pruned"] is False
+
+
+def test_gemm_closed_form_peak_is_sane():
+    """gemm's peak = per-device operand residency + the largest staged
+    communication buffer: at least the A+B+C shards, well under the
+    whole-matrix total."""
+    g = _grid(2, 2)
+    m = k = n = 256
+    b = cm.score_config("gemm", {"alg": "A", "nb": 64,
+                                 "comm_precision": None,
+                                 "redist_path": "gather"},
+                        ctx=_ctx("gemm", (m, k, n), g), dtype=jnp.float32)
+    shards = (m * k + k * n + m * n) * 4 / 4
+    assert b.peak_bytes >= shards
+    assert b.peak_bytes < 3 * (m * k + k * n + m * n) * 4
+    assert not b.pruned
+
+
+def test_tiny_hbm_prunes_candidates():
+    g = _grid(2, 2)
+    tiny = _tiny_machine()
+    for op, dims, config in [
+            ("cholesky", (64, 64), {"nb": 16, "lookahead": False,
+                                    "crossover": 0}),
+            ("gemm", (256, 256, 256), {"alg": "A", "nb": 64,
+                                       "comm_precision": None,
+                                       "redist_path": "gather"})]:
+        b = cm.score_config(op, config, ctx=_ctx(op, dims, g),
+                            grid=g, dtype=jnp.float32, machine=tiny)
+        assert b.pruned, (op, b.peak_bytes)
+        assert b.to_doc()["pruned"] is True
+
+
+def test_explain_ranks_pruned_candidates_last():
+    g = _grid(2, 2)
+    _, scored = policy.explain("cholesky", gshape=(64, 64),
+                               dtype=jnp.float32, grid=g,
+                               machine=_tiny_machine(hbm=2.0e4))
+    flags = [b.pruned for b in scored]
+    if any(flags) and not all(flags):
+        assert flags == sorted(flags), \
+            "a pruned candidate outranked a fitting one"
+
+
+def test_all_pruned_still_resolves():
+    """With 1 KiB of 'HBM' every candidate is over budget; resolution
+    must still pick one (the fastest) instead of erroring."""
+    g = _grid(2, 2)
+    res = policy.resolve("cholesky", gshape=(64, 64), dtype=jnp.float32,
+                         grid=g,
+                         requested={"nb": "auto", "lookahead": "auto",
+                                    "crossover": "auto"},
+                         machine=_tiny_machine())
+    assert res.config["nb"] is not None
+    choice, scored = policy.explain("cholesky", gshape=(64, 64),
+                                    dtype=jnp.float32, grid=g,
+                                    machine=_tiny_machine())
+    assert all(b.pruned for b in scored)
+    assert choice is not None
+
+
+def test_pruning_overrides_modeled_time():
+    """Between a fast-but-OOM candidate and a slow-but-fitting one the
+    tuner must take the fitting one: sort key is (pruned, total_s)."""
+    g = _grid(2, 2)
+    ctx = _ctx("cholesky", (64, 64), g)
+    fast = cm.score_config("cholesky", {"nb": 32, "lookahead": True,
+                                        "crossover": 0},
+                           ctx=ctx, grid=g, dtype=jnp.float32)
+    slow = cm.score_config("cholesky", {"nb": 8, "lookahead": False,
+                                        "crossover": 0},
+                           ctx=ctx, grid=g, dtype=jnp.float32)
+    a, b = sorted([fast, slow], key=lambda x: x.total_s)
+    forced = dataclasses.replace(a, pruned=True)
+    order = sorted([forced, b], key=lambda x: (x.pruned, x.total_s))
+    assert order[0] is b, "OOM risk must dominate modeled speed"

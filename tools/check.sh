@@ -50,6 +50,15 @@
 #                             #   1x1 + 2x2, pivot-identical LU), the
 #                             #   comm-plan byte-invariance sweep under
 #                             #   panel_impl='pallas', and tests/kernels
+#   tools/check.sh static     # the one-stop static slice (ISSUE 18): ruff
+#                             #   (or pyflakes_lite), comm-plan lint, the
+#                             #   memory-plan lint (EL006-EL009: peak
+#                             #   budgets, VMEM gate cross-check, missing
+#                             #   donation, double materialization), the
+#                             #   golden memory-plan diff, and the
+#                             #   registry-driven golden-coverage check
+#                             #   over BOTH golden families -- no device
+#                             #   execution anywhere
 #   tools/check.sh redist     # one-shot redistribution gate (ISSUE 12 +
 #                             #   13): plan-compiler unit + direct-vs-
 #                             #   chain bit-equivalence tests (incl.
@@ -110,26 +119,9 @@ if [ "$what" = "all" ] || [ "$what" = "lapack" ]; then
     python -m perf.comm_audit diff lu || rc=1
     python -m perf.comm_audit diff qr || rc=1
     echo "== golden coverage: every registered driver variant has snapshots =="
-    # fail LOUDLY on a registered analysis variant with no golden snapshot
-    # (a variant that never got `comm_audit diff --update-golden` would
-    # otherwise only surface when the full diff --all gate runs)
-    python - <<'PY' || rc=1
-import os, sys
-sys.path.insert(0, os.getcwd())
-from perf.comm_audit import GRIDS, GOLDEN_DIR, golden_path, _bootstrap
-_bootstrap()
-from elemental_tpu import analysis as an
-missing = [f"{d} {r}x{c}" for d in an.driver_names() for (r, c) in GRIDS
-           if not os.path.exists(golden_path(d, (r, c)))]
-if missing:
-    print("MISSING golden snapshot(s) for registered driver variant(s):")
-    for m in missing:
-        print(f"  {m}   (run: python -m perf.comm_audit diff "
-              f"{m.split()[0]} --update-golden)")
-    sys.exit(1)
-print(f"golden coverage ok ({len(an.driver_names())} drivers x "
-      f"{len(GRIDS)} grids)")
-PY
+    # registry-driven, both golden families (comm_plan + memory_plan);
+    # replaces the old per-gate heredoc copies (ISSUE 18 satellite)
+    python tools/golden_coverage.py || rc=1
     echo "== lapack calu/tsqr tier-1 tests =="
     python -m pytest tests/lapack/test_lu.py tests/lapack/test_lu_calu.py \
         tests/lapack/test_qr.py tests/lapack/test_qr_tsqr.py \
@@ -316,6 +308,29 @@ print(f"comm-plan invariance ok ({len(fams)} variants x {len(GRIDS)} grids)")
 PY
     echo "== kernels tests, full ladder incl. slow rungs =="
     python -m pytest tests/kernels -q -p no:cacheprovider || rc=1
+fi
+
+if [ "$what" = "all" ] || [ "$what" = "static" ]; then
+    # the one-stop static slice (ISSUE 18): no device execution anywhere.
+    # `check.sh static` alone also re-runs style + comm lint so it is a
+    # self-contained pre-commit entry point; under `all` those two already
+    # ran above and only the memory-side checks are new work here.
+    if [ "$what" = "static" ]; then
+        echo "== style lint =="
+        if command -v ruff >/dev/null 2>&1; then
+            ruff check . || rc=1
+        else
+            python tools/pyflakes_lite.py || rc=1
+        fi
+        echo "== comm-plan lint =="
+        python -m perf.comm_audit lint --all || rc=1
+    fi
+    echo "== memory-plan lint (EL006-EL009) =="
+    python -m perf.comm_audit mem-lint --all || rc=1
+    echo "== golden memory-plan diff =="
+    python -m perf.comm_audit mem-diff --all || rc=1
+    echo "== golden coverage (comm + memory families) =="
+    python tools/golden_coverage.py || rc=1
 fi
 
 if [ "$what" = "all" ] || [ "$what" = "serve" ]; then

@@ -1,0 +1,71 @@
+"""Registry-driven golden-coverage check (ISSUE 18 satellite).
+
+Fails LOUDLY when any registered analysis driver variant lacks a golden
+snapshot on the audit grids (1x1 + 2x2) -- for BOTH golden families:
+``comm_plan/v1`` under ``tests/golden/comm_plans/`` and
+``memory_plan/v1`` under ``tests/golden/memory_plans/``.
+
+This replaces the per-gate heredoc copies that ``tools/check.sh`` used
+to carry: ONE check, driven by the registry itself, so a newly
+registered variant (``gemm_slice``, ``qr_abft``, a future pallas-only
+driver, anything) with no snapshot breaks the gate the day it lands
+instead of whenever the full ``diff --all`` path happens to run.  The
+pallas panel overrides deliberately share the xla variants' snapshots
+(comm/memory plans are panel-impl invariant; ``tools/check.sh kernels``
+pins that), so coverage is per REGISTERED DRIVER NAME, the unit the
+registry defines.
+
+    python tools/golden_coverage.py           # check both families
+    python tools/golden_coverage.py comm      # comm_plan goldens only
+    python tools/golden_coverage.py mem       # memory_plan goldens only
+
+Exit 0 on full coverage, 1 with a per-variant remediation command
+otherwise.
+"""
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    family = argv[0] if argv else "all"
+    if family not in ("all", "comm", "mem"):
+        raise SystemExit(f"unknown golden family {family!r}; "
+                         f"expected comm|mem|all")
+    if _REPO not in sys.path:
+        sys.path.insert(0, _REPO)
+    from perf.comm_audit import (GRIDS, _bootstrap, golden_path,
+                                 mem_golden_path)
+    _bootstrap()
+    from elemental_tpu import analysis as an
+    names = an.driver_names()
+    families = []
+    if family in ("all", "comm"):
+        families.append(("comm_plan", golden_path, "diff"))
+    if family in ("all", "mem"):
+        families.append(("memory_plan", mem_golden_path, "mem-diff"))
+    missing = []
+    for label, path_fn, cmd in families:
+        for d in names:
+            for grid in GRIDS:
+                if not os.path.exists(path_fn(d, grid)):
+                    missing.append(
+                        (f"{label} {d} {grid[0]}x{grid[1]}",
+                         f"python -m perf.comm_audit {cmd} {d} "
+                         f"--update-golden"))
+    if missing:
+        print("MISSING golden snapshot(s) for registered driver "
+              "variant(s):")
+        for what, fix in missing:
+            print(f"  {what}   (run: {fix})")
+        return 1
+    print(f"golden coverage ok ({len(names)} drivers x {len(GRIDS)} "
+          f"grids x {len(families)} famil"
+          f"{'y' if len(families) == 1 else 'ies'})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
