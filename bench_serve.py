@@ -11,13 +11,23 @@ Prints ONE JSON line (``bench_serve/v1``)::
      "serve_async_exec_compiles": 0, "serve_async_batches": ...,
      "serve_pipeline_occupancy": ..., "serve_async_payload_identical":
      true, "grid": [r, c], "backend": "cpu", "n": ...,
-     "warmup_requests": ...}
+     "warmup_requests": ...,
+     "serve_fleet_p50_ms": ..., "serve_fleet_p99_ms": ...,
+     "serve_fleet_solves_per_sec": ..., "serve_fleet_requests": ...,
+     "serve_fleet_ok": ..., "serve_fleet_n": ...,
+     "serve_fleet_grids_used": ["g0", "g1"], "serve_fleet_scaling": ...,
+     "serve_fleet_busy_single_s": ..., "serve_fleet_busy_per_grid_s":
+     [...], "serve_fleet_scaling_ok": ...}
 
 into the BENCH flow: ``tools/bench_diff.py`` gates ``serve_p99_ms`` /
-``serve_async_p99_ms`` (lower-is-better) and ``serve_solves_per_sec`` /
-``serve_async_solves_per_sec`` alongside the TFLOP/s headlines, so a
+``serve_async_p99_ms`` / ``serve_fleet_p99_ms`` (lower-is-better) and
+``serve_solves_per_sec`` / ``serve_async_solves_per_sec`` /
+``serve_fleet_solves_per_sec`` alongside the TFLOP/s headlines, so a
 serving-latency regression fails the gate exactly like a
-factorization-throughput regression.
+factorization-throughput regression.  The ``serve_fleet_*`` section is
+the ISSUE-19 multi-grid fleet (see :func:`run_fleet_bench`): real-wall
+percentiles through a pipelined 2-member fleet plus the device-busy
+2-grid-vs-1-grid scaling ratio with its 1.8x acceptance floor.
 
 Methodology: a WARMUP pass first touches every (bucket, batch-slot)
 geometry so AOT compiles happen outside the measured window (that is the
@@ -173,6 +183,162 @@ def run_bench(requests: int, n: int, grid_spec, seed: int) -> dict:
     }
 
 
+class _BusyMeter:
+    """Executor shim metering device-busy wall seconds per fleet member
+    (the denominator of the multi-grid scaling metric)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.busy_s = 0.0
+
+    def run(self, bucket, reqs):
+        t0 = time.perf_counter()
+        out = self._inner.run(bucket, reqs)
+        self.busy_s += time.perf_counter() - t0
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def run_fleet_bench(requests: int, n: int, seed: int) -> dict:
+    """The multi-grid fleet section (ISSUE 19).
+
+    Two measurements over a SINGLE-bucket hpd workload (identical
+    geometry per request, so every batch fills completely and the
+    grids=1 vs grids=2 comparison is slot-for-slot fair; the request
+    count rounds UP to a multiple of ``grids x max_batch`` so neither
+    geometry pays padding the other does not):
+
+      * ``serve_fleet_solves_per_sec`` / ``serve_fleet_p50_ms`` /
+        ``serve_fleet_p99_ms`` -- real wall clock through the PIPELINED
+        2-grid fleet (each member depth-2 on its own pinned device),
+        warmed so no measured request pays compile;
+      * ``serve_fleet_scaling`` -- aggregate throughput of the 2-grid
+        fleet vs ONE grid at equal total device count, computed in
+        DEVICE-BUSY time: (single-grid total batch-execution seconds) /
+        (the 2-grid fleet's most-loaded member's seconds), the median of
+        five interleaved repeats.  Perfect partitioning gives 2.0; the
+        acceptance floor is 1.8.  Busy time
+        rather than wall clock because this host is frequently a
+        single-core CI runner where two members' real batches serialize
+        on the CPU -- busy time measures what the partition would buy on
+        hardware that can actually run members concurrently, the same
+        honest-numbers convention as the async occupancy gauge.
+    """
+    import numpy as np
+    from elemental_tpu.serve import SolverFleet
+
+    # floor the problem size: sub-millisecond batches are dispatch-
+    # overhead-dominated and jitter 30%+ on a shared core, which is
+    # noise the 1.8x scaling floor cannot absorb; n=96 batches run
+    # ~7 ms and repeat within a few percent
+    n = max(n, 96)
+
+    def workload(rng, count):
+        out = []
+        for _ in range(count):
+            F = rng.normal(size=(n, n)).astype(np.float32)
+            A = (F @ F.T / n + n * np.eye(n)).astype(np.float32)
+            B = rng.normal(size=(n, 2)).astype(np.float32)
+            out.append((A, B))
+        return out
+
+    probe = SolverFleet(grids=2, pipelined=False, shed=False)
+    mb = probe.max_batch
+    probe.shutdown(drain=True)
+    span = 2 * mb
+    count = max(span, -(-requests // span) * span)
+
+    # real-wall pipelined fleet: warm pass (compiles per pinned device),
+    # then the measured pass
+    fleet = SolverFleet(grids=2, depth=2, shed=False)
+    rng = np.random.default_rng(seed)
+    for f in [fleet.submit("hpd", A, B, tenant=f"t{i % 2}")
+              for i, (A, B) in enumerate(workload(rng, count))]:
+        f.result(timeout=600.0)
+    # equalize member EWMAs after warmup: warm routing hands members
+    # different batch SIZES (the EWMA tracks batch seconds, not
+    # per-request seconds), and over a window this short the skew would
+    # route the whole measured pass to whichever member happened to run
+    # small warm batches -- start symmetric so the split reflects load
+    keys = set()
+    for svc in fleet.services:
+        keys |= set(svc.admission._ewma)
+    for k in keys:
+        vals = [svc.admission._ewma[k] for svc in fleet.services
+                if k in svc.admission._ewma]
+        for svc in fleet.services:
+            svc.admission._ewma[k] = max(vals)
+    t0 = time.perf_counter()
+    futs = [fleet.submit("hpd", A, B, tenant=f"t{i % 2}")
+            for i, (A, B) in enumerate(workload(rng, count))]
+    outs = [f.result(timeout=600.0) for f in futs]
+    wall = time.perf_counter() - t0
+    fleet.shutdown(drain=True)
+    lats = sorted(d["latency_s"] for _, d in outs)
+    ok = sum(d["status"] == "ok" for _, d in outs)
+    grids_used = sorted({d["grid"] for _, d in outs})
+
+    # device-busy scaling: the same workload through sync fleets of 1
+    # and 2 grids over the SAME total device set, each warmed, each
+    # member's executor metered
+    def busy_fleet(grids):
+        fl = SolverFleet(grids=grids, pipelined=False, shed=False)
+        meters = []
+        for svc in fl.services:
+            m = _BusyMeter(svc.executor)
+            svc.executor = m
+            meters.append(m)
+        rngb = np.random.default_rng(seed + 1)
+        for A, B in workload(rngb, count):
+            fl.submit("hpd", A, B)
+        fl.drain()
+        return fl, meters
+
+    def busy_repeat(fl, meters):
+        for m in meters:
+            m.busy_s = 0.0
+        rngb = np.random.default_rng(seed + 2)
+        futs = [fl.submit("hpd", A, B) for A, B in workload(rngb, count)]
+        fl.drain()
+        okb = sum(f.result(timeout=0)[1].get("status") == "ok"
+                  for f in futs)
+        return [m.busy_s for m in meters], okb
+
+    # both fleets warmed up front, then INTERLEAVED repeats with a
+    # per-repeat ratio: single batches on a shared CI core jitter 30%+
+    # and the host drifts between seconds, so back-to-back pairing
+    # cancels the common mode and the median ratio ignores the one
+    # repeat the host stepped on
+    fl1, meters1 = busy_fleet(1)
+    fl2, meters2 = busy_fleet(2)
+    pairs, ok1, ok2 = [], count, count
+    for _ in range(5):
+        b1, o1 = busy_repeat(fl1, meters1)
+        b2, o2 = busy_repeat(fl2, meters2)
+        ok1, ok2 = min(ok1, o1), min(ok2, o2)
+        if max(b2) > 0:
+            pairs.append((sum(b1) / max(b2), b1, b2))
+    fl1.shutdown(drain=True)
+    fl2.shutdown(drain=True)
+    scaling, busy1, busy2 = (sorted(pairs)[len(pairs) // 2]
+                             if pairs else (None, [0.0], [0.0]))
+    return {
+        "serve_fleet_p50_ms": 1e3 * _percentile(lats, 0.50),
+        "serve_fleet_p99_ms": 1e3 * _percentile(lats, 0.99),
+        "serve_fleet_solves_per_sec": len(outs) / wall if wall > 0
+        else None,
+        "serve_fleet_requests": count, "serve_fleet_ok": ok,
+        "serve_fleet_n": n,
+        "serve_fleet_grids_used": grids_used,
+        "serve_fleet_scaling": scaling,
+        "serve_fleet_busy_single_s": sum(busy1),
+        "serve_fleet_busy_per_grid_s": busy2,
+        "serve_fleet_scaling_ok": int(ok1) + int(ok2),
+    }
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] in ("-h", "--help"):
@@ -203,6 +369,7 @@ def main(argv=None) -> int:
     from perf.trace import _bootstrap
     _bootstrap()
     doc = run_bench(requests, n, grid_spec, seed)
+    doc.update(run_fleet_bench(requests, n, seed))
     print(json.dumps(doc))
     if smoke:
         # schema sanity: the gateable keys must be present and numeric,
@@ -211,9 +378,22 @@ def main(argv=None) -> int:
                            "serve_solves_per_sec", "serve_async_p50_ms",
                            "serve_async_p99_ms",
                            "serve_async_solves_per_sec",
-                           "serve_pipeline_occupancy")
+                           "serve_pipeline_occupancy",
+                           "serve_fleet_p50_ms", "serve_fleet_p99_ms",
+                           "serve_fleet_solves_per_sec",
+                           "serve_fleet_scaling")
                if not isinstance(doc.get(k), (int, float))]
         contract = []
+        if doc["serve_fleet_ok"] != doc["serve_fleet_requests"]:
+            contract.append("fleet requests not all ok")
+        if doc["serve_fleet_grids_used"] != ["g0", "g1"]:
+            contract.append("fleet left a member idle")
+        if doc["serve_fleet_scaling_ok"] != 2 * doc["serve_fleet_requests"]:
+            contract.append("scaling passes not all ok")
+        if isinstance(doc.get("serve_fleet_scaling"), (int, float)) \
+                and doc["serve_fleet_scaling"] < 1.8:
+            contract.append(
+                f"fleet scaling {doc['serve_fleet_scaling']:.2f} < 1.8")
         if doc["serve_async_exec_compiles"] != 0:
             contract.append("async measured window compiled")
         if not doc["serve_async_payload_identical"]:

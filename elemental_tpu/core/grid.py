@@ -59,6 +59,11 @@ class Grid:
         return self._r * self._c
 
     @property
+    def devices(self) -> tuple:
+        """The grid's devices in row-major (mc, mr) order."""
+        return self._devices
+
+    @property
     def lcm(self) -> int:     # MD stride in the reference
         return self._r * self._c // math.gcd(self._r, self._c)
 

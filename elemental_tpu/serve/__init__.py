@@ -25,14 +25,25 @@ into a system that survives production traffic -- the ROADMAP's
                      batch buffers), completions stream as
                      :class:`ServeFuture` resolutions
   :mod:`.chaos`      the acceptance-matrix harness over the ISSUE-7
-                     ``FaultPlan`` machinery
+                     ``FaultPlan`` machinery, grown a fleet column
+                     (saturation + grid loss, ISSUE 19)
+  :mod:`.scheduler`  :class:`FairScheduler` -- per-tenant deficit-round-
+                     robin queues and :class:`TenantQuota` outstanding
+                     caps (ISSUE 19)
+  :mod:`.fleet`      :class:`SolverFleet` -- the ISSUE-19 tentpole:
+                     devices partitioned into independent solver grids
+                     (own executor cache / breakers / tuner namespace /
+                     EWMA each), depth-k pipelined workers, and
+                     tenant-aware routing by measured per-grid latency
 
-CLI: ``python -m perf.serve {run,smoke,chaos}``; bench:
-``python bench_serve.py`` (p50/p99 + solves/sec, gated by
-``tools/bench_diff.py``); gate: ``tools/check.sh serve``.
+CLI: ``python -m perf.serve {run,smoke,chaos,fleet-smoke}``; bench:
+``python bench_serve.py`` (p50/p99 + solves/sec + the multi-grid fleet
+section, gated by ``tools/bench_diff.py``); gates: ``tools/check.sh
+serve`` and ``tools/check.sh fleet``.
 """
 from .admission import (REJECT_SCHEMA, AdmissionController, Bucket,
-                        Deadline, SolveRequest, make_bucket, reject_doc)
+                        Deadline, SolveRequest, make_bucket, reject_doc,
+                        validate_problem)
 from .executor import (EXEC_SCHEMA, ExecutableCache, Executor, batch_slots,
                        ls_residual, pad_problem, pad_problem_ls, residual,
                        route_for, tune_token)
@@ -42,8 +53,13 @@ from .service import RESULT_SCHEMA, SolverService
 from .async_front import (AsyncSolverService, ServeFuture,
                           donation_safe, serve_async)
 from .chaos import (CHAOS_SCHEMA, build_workload, chaos_matrix,
-                    replay_identical, run_async_cell,
-                    run_async_shutdown_cell, run_cell, run_qr_cell)
+                    fleet_replay_identical, replay_identical,
+                    run_async_cell, run_async_shutdown_cell, run_cell,
+                    run_fleet_grid_loss_cell, run_fleet_saturation_cell,
+                    run_qr_cell)
+from .scheduler import DEFAULT_TENANT, FairScheduler, TenantQuota
+from .fleet import (FleetFuture, GridWorker, SolverFleet,
+                    partition_devices)
 
 __all__ = [
     "REJECT_SCHEMA", "AdmissionController", "Bucket", "Deadline",
@@ -58,4 +74,9 @@ __all__ = [
     "donation_safe",
     "CHAOS_SCHEMA", "build_workload", "chaos_matrix", "replay_identical",
     "run_async_cell", "run_async_shutdown_cell", "run_cell", "run_qr_cell",
+    "fleet_replay_identical", "run_fleet_grid_loss_cell",
+    "run_fleet_saturation_cell",
+    "DEFAULT_TENANT", "FairScheduler", "TenantQuota",
+    "FleetFuture", "GridWorker", "SolverFleet", "partition_devices",
+    "validate_problem",
 ]

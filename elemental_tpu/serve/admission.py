@@ -47,8 +47,10 @@ REJECT_SCHEMA = "serve_reject/v1"
 #: NOT executed gets this structured reject instead of being dropped.
 #: 'memory_pressure' (ISSUE 18): the bucket's statically derived peak
 #: bytes at max_batch do not fit the configured per-device HBM.
+#: 'quota' (ISSUE 19): the submitting tenant is at its configured
+#: max-outstanding limit in the fleet's fair scheduler.
 REJECT_REASONS = ("queue_pressure", "deadline_expired", "breaker_open",
-                  "bad_request", "shutdown", "memory_pressure")
+                  "bad_request", "shutdown", "memory_pressure", "quota")
 
 #: cold-start throughput assumption for the flops-based cost seed,
 #: flop/s.  Deliberately modest (CPU-class): a cold service sheds
@@ -152,6 +154,7 @@ class SolveRequest:
     bucket: Bucket
     deadline: Deadline | None
     submitted: float             # admission clock timestamp
+    tenant: str | None = None    # fleet tenant (ISSUE 19), None = direct
 
     @property
     def n(self) -> int:
@@ -164,8 +167,14 @@ class SolveRequest:
 
 def reject_doc(reason: str, *, bucket: Bucket | None = None,
                queue_depth: int = 0, estimate_s: float | None = None,
-               deadline: Deadline | None = None, detail: str = "") -> dict:
-    """A structured fast-reject (``serve_reject/v1``)."""
+               deadline: Deadline | None = None, detail: str = "",
+               grid: str | None = None, tenant: str | None = None) -> dict:
+    """A structured fast-reject (``serve_reject/v1``).
+
+    ``grid`` / ``tenant`` (ISSUE 19) attribute the decision to the fleet
+    member that made it and the quota bucket it was charged against;
+    both default to None for the single-service path, so old documents
+    and old readers stay valid (absent == None)."""
     if reason not in REJECT_REASONS:
         raise ValueError(f"unknown reject reason {reason!r}; "
                          f"expected one of {REJECT_REASONS}")
@@ -174,7 +183,39 @@ def reject_doc(reason: str, *, bucket: Bucket | None = None,
             "queue_depth": int(queue_depth),
             "estimate_s": None if estimate_s is None else float(estimate_s),
             "deadline": deadline.to_doc() if deadline is not None else None,
-            "detail": detail}
+            "detail": detail, "grid": grid, "tenant": tenant}
+
+
+def validate_problem(op: str, A, B):
+    """Canonicalize ONE request: op aliasing, shape/dtype checks, and
+    the tuner-aligned bucket.  Returns ``(op, A, B, bucket)`` on success
+    or a ``serve_reject/v1`` dict (``reason='bad_request'``) -- the
+    validation half of :meth:`AdmissionController.admit`, split out so
+    the fleet router (ISSUE 19) can bucket a request BEFORE choosing
+    which grid's admission controller will see it."""
+    op = "hpd" if op == "cholesky" else op
+    op = "lstsq" if op == "qr" else op
+    if op not in ("lu", "hpd", "lstsq"):
+        return reject_doc(
+            "bad_request",
+            detail=f"op must be 'lu', 'hpd' or 'lstsq', got {op!r}")
+    A = np.asarray(A)
+    B = np.asarray(B)
+    if B.ndim == 1:
+        B = B[:, None]
+    square_ok = A.ndim == 2 and A.shape[0] == A.shape[1]
+    tall_ok = A.ndim == 2 and A.shape[0] >= A.shape[1]
+    shape_ok = (tall_ok if op == "lstsq" else square_ok) \
+        and B.ndim == 2 and B.shape[0] == A.shape[0]
+    if not shape_ok:
+        return reject_doc("bad_request",
+                          detail=f"bad shapes A{A.shape} B{B.shape}")
+    if not np.issubdtype(A.dtype, np.inexact):
+        A = A.astype(np.float64)
+        B = B.astype(np.float64)
+    bucket = make_bucket(op, A.shape[1], B.shape[1], A.dtype,
+                         m=A.shape[0] if op == "lstsq" else None)
+    return op, A, B, bucket
 
 
 class AdmissionController:
@@ -189,7 +230,8 @@ class AdmissionController:
 
     def __init__(self, *, shed: bool = True, max_batch: int = 8,
                  flops_per_s: float = COLD_FLOPS_PER_S,
-                 clock=time.monotonic, hbm_bytes: float | None = None):
+                 clock=time.monotonic, hbm_bytes: float | None = None,
+                 pipeline_depth: int = 2, grid: str | None = None):
         self.shed = bool(shed)
         self.max_batch = max(int(max_batch), 1)
         self.flops_per_s = float(flops_per_s)
@@ -198,6 +240,14 @@ class AdmissionController:
         #: None = the backend default from the tuner's machine table,
         #: resolved lazily (jax must not initialize at import time)
         self.hbm_bytes = None if hbm_bytes is None else float(hbm_bytes)
+        #: resident batches the worker keeps in flight (ISSUE 19): the
+        #: memory-pressure threshold is ``depth x`` the single-batch
+        #: peak -- 2 for the classic double buffer, k for a depth-k
+        #: pipelined fleet member
+        self.pipeline_depth = max(int(pipeline_depth), 1)
+        #: fleet member name stamped into every reject this controller
+        #: issues (None for a direct single-service deployment)
+        self.grid = grid
         self._ids = itertools.count()
         self._ewma: dict = {}            # bucket.key() -> seconds per batch
         self._peak_memo: dict = {}       # bucket.key() -> peak bytes | None
@@ -230,16 +280,19 @@ class AdmissionController:
     def memory_pressure(self, bucket: Bucket):
         """(peak bytes, budget) when the bucket CANNOT fit, else None.
 
-        The double-buffered worker keeps two batches resident (one on
-        device, one staging), so the shed threshold is 2x the single
-        batch peak against the per-device HBM budget."""
+        The pipelined worker keeps ``pipeline_depth`` batches resident
+        (in flight on device + staging), so the shed threshold is
+        ``depth x`` the single batch peak against the per-device HBM
+        budget -- 2x for the classic double buffer.  A fleet member with
+        a small per-grid budget therefore sheds a bucket its big-grid
+        pool-mate still admits (ISSUE 19)."""
         if not self.shed:
             return None
         peak = self.bucket_peak_bytes(bucket)
         if peak is None:
             return None
         budget = self._hbm_budget()
-        if 2.0 * peak > budget:
+        if self.pipeline_depth * peak > budget:
             return peak, budget
         return None
 
@@ -268,35 +321,20 @@ class AdmissionController:
 
     # ---- admission ---------------------------------------------------
     def admit(self, op: str, A, B, deadline: Deadline | None = None,
-              queue_depth=0):
+              queue_depth=0, tenant: str | None = None):
         """One admission decision: :class:`SolveRequest` or reject dict.
 
         ``queue_depth`` is the number of same-bucket requests already
         waiting -- an int, or a callable ``bucket -> int`` (the bucket is
         only known after validation, so a queue-owning caller passes its
-        depth lookup)."""
-        op = "hpd" if op == "cholesky" else op
-        op = "lstsq" if op == "qr" else op
-        if op not in ("lu", "hpd", "lstsq"):
-            return reject_doc(
-                "bad_request",
-                detail=f"op must be 'lu', 'hpd' or 'lstsq', got {op!r}")
-        A = np.asarray(A)
-        B = np.asarray(B)
-        if B.ndim == 1:
-            B = B[:, None]
-        square_ok = A.ndim == 2 and A.shape[0] == A.shape[1]
-        tall_ok = A.ndim == 2 and A.shape[0] >= A.shape[1]
-        shape_ok = (tall_ok if op == "lstsq" else square_ok) \
-            and B.ndim == 2 and B.shape[0] == A.shape[0]
-        if not shape_ok:
-            return reject_doc("bad_request",
-                              detail=f"bad shapes A{A.shape} B{B.shape}")
-        if not np.issubdtype(A.dtype, np.inexact):
-            A = A.astype(np.float64)
-            B = B.astype(np.float64)
-        bucket = make_bucket(op, A.shape[1], B.shape[1], A.dtype,
-                             m=A.shape[0] if op == "lstsq" else None)
+        depth lookup).  ``tenant`` rides into the request and every
+        reject this call issues (the fleet path, ISSUE 19)."""
+        v = validate_problem(op, A, B)
+        if isinstance(v, dict):
+            v["grid"] = self.grid
+            v["tenant"] = tenant
+            return v
+        op, A, B, bucket = v
         if callable(queue_depth):
             queue_depth = int(queue_depth(bucket))
         pressure = self.memory_pressure(bucket)
@@ -304,22 +342,27 @@ class AdmissionController:
             peak, budget = pressure
             return reject_doc(
                 "memory_pressure", bucket=bucket, queue_depth=queue_depth,
-                deadline=deadline,
-                detail=f"static peak {int(peak)} B/batch x2 (double "
-                       f"buffer) exceeds the {int(budget)} B HBM budget")
+                deadline=deadline, grid=self.grid, tenant=tenant,
+                detail=f"static peak {int(peak)} B/batch x"
+                       f"{self.pipeline_depth} ("
+                       + ("double buffer"
+                          if self.pipeline_depth == 2
+                          else f"pipeline depth {self.pipeline_depth}")
+                       + f") exceeds the {int(budget)} B HBM budget")
         if deadline is not None:
             if deadline.expired():
                 return reject_doc("deadline_expired", bucket=bucket,
-                                  queue_depth=queue_depth, deadline=deadline)
+                                  queue_depth=queue_depth, deadline=deadline,
+                                  grid=self.grid, tenant=tenant)
             if self.shed:
                 wait = self.estimated_wait_s(bucket, queue_depth)
                 if wait > deadline.remaining():
                     return reject_doc(
                         "queue_pressure", bucket=bucket,
                         queue_depth=queue_depth, estimate_s=wait,
-                        deadline=deadline,
+                        deadline=deadline, grid=self.grid, tenant=tenant,
                         detail=f"estimated wait {wait:.3g}s exceeds "
                                f"remaining {deadline.remaining():.3g}s")
         return SolveRequest(id=next(self._ids), op=op, A=A, B=B,
                             bucket=bucket, deadline=deadline,
-                            submitted=self.clock())
+                            submitted=self.clock(), tenant=tenant)

@@ -5,11 +5,13 @@ The core stays deliberately synchronous and deterministic; this module
 adds exactly one worker thread and a thread-safe submission queue, and
 gets its throughput from TWO overlaps the synchronous path cannot have:
 
-  * **double buffering** -- jax dispatch is asynchronous, so the worker
-    STAGES (host pad/stack + executable lookup) and DISPATCHES batch
-    k+1 while batch k is still executing on device, and only then
-    collects batch k.  The device runs back-to-back batches; the host
-    pays its staging latency in the device's shadow::
+  * **depth-k pipelining** -- jax dispatch is asynchronous, so the
+    worker STAGES (host pad/stack + executable lookup) and DISPATCHES
+    up to ``depth`` batches before collecting the oldest.  The default
+    ``depth=2`` is the classic double buffer (bit-identical to the
+    ISSUE-14 worker); a fleet member facing a deep submit queue (ISSUE
+    19) runs ``depth=3+`` so the device queue never drains even when
+    one host-side collect runs long::
 
         host   : stage k | stage k+1 | collect k | stage k+2 | collect k+1
         device :         |-- solve k --|-- solve k+1 --|-- solve k+2 --|
@@ -142,11 +144,13 @@ class _Submission:
     """One enqueued submit (plain struct; also the wake-up sentinel when
     ``future is None``)."""
 
-    __slots__ = ("op", "A", "B", "deadline", "future")
+    __slots__ = ("op", "A", "B", "deadline", "future", "tenant")
 
-    def __init__(self, op=None, A=None, B=None, deadline=None, future=None):
+    def __init__(self, op=None, A=None, B=None, deadline=None, future=None,
+                 tenant=None):
         self.op, self.A, self.B = op, A, B
         self.deadline, self.future = deadline, future
+        self.tenant = tenant
 
 
 class AsyncSolverService:
@@ -157,11 +161,16 @@ class AsyncSolverService:
 
     def __init__(self, service: SolverService | None = None, *,
                  donate: bool = True, poll_s: float = POLL_S,
-                 autostart: bool = True, **core_kw):
-        self.service = service if service is not None \
-            else SolverService(**core_kw)
+                 autostart: bool = True, depth: int = 2, **core_kw):
+        if service is None:
+            core_kw.setdefault("pipeline_depth", max(int(depth), 1))
+            service = SolverService(**core_kw)
+        self.service = service
         self.donate = bool(donate) and donation_safe()
         self.poll_s = float(poll_s)
+        #: batches kept dispatched before collecting the oldest (ISSUE
+        #: 19): 2 = the classic double buffer, k > 2 = deep pipelining
+        self.depth = max(int(depth), 1)
         self._qin: queue.Queue = queue.Queue()
         self._futures: dict = {}         # core request id -> ServeFuture
         self._settled: list = []         # worker-appended (id, doc) ledger
@@ -187,7 +196,7 @@ class AsyncSolverService:
     # ---- client side -------------------------------------------------
     def submit(self, op: str, A, B, *, budget_s: float | None = None,
                deadline: Deadline | None = None,
-               callback=None) -> ServeFuture:
+               callback=None, tenant: str | None = None) -> ServeFuture:
         """Enqueue one request; returns its :class:`ServeFuture`.
 
         Rejections (load shed, expired deadline, open breaker, bad
@@ -202,10 +211,11 @@ class AsyncSolverService:
         if self._stop:
             _metrics.inc("serve_rejects", reason="shutdown")
             fut._resolve(reject_doc("shutdown", deadline=deadline,
+                                    grid=self.service.name, tenant=tenant,
                                     detail="async service has shut down"),
                          None)
             return fut
-        self._qin.put(_Submission(op, A, B, deadline, fut))
+        self._qin.put(_Submission(op, A, B, deadline, fut, tenant))
         _metrics.set_gauge("serve_async_submit_queue", self._qin.qsize())
         return fut
 
@@ -275,7 +285,8 @@ class AsyncSolverService:
             if self._stop and not self._drain:
                 self._flush_submission(sub)
                 continue
-            out = svc.submit(sub.op, sub.A, sub.B, deadline=sub.deadline)
+            out = svc.submit(sub.op, sub.A, sub.B, deadline=sub.deadline,
+                             tenant=sub.tenant)
             if isinstance(out, dict):    # structured fast reject
                 sub.future._resolve(out, None)
             else:
@@ -288,6 +299,7 @@ class AsyncSolverService:
         _metrics.inc("serve_rejects", reason="shutdown")
         sub.future._resolve(
             reject_doc("shutdown", deadline=sub.deadline,
+                       grid=self.service.name, tenant=sub.tenant,
                        detail="flushed by shutdown(drain=False)"), None)
 
     def _stage_next(self):
@@ -329,34 +341,39 @@ class AsyncSolverService:
         svc._complete_batch(bucket, staged.requests, xs, seconds)
 
     def _run(self) -> None:
+        import collections
         svc = self.service
-        inflight = None
+        pipeline: collections.deque = collections.deque()
         while True:
             stopping = self._stop
-            self._ingest(block=(inflight is None and not stopping
+            self._ingest(block=(not pipeline and not stopping
                                 and not svc._queues))
             if self._stop and not self._drain:
                 # emergency stop: let the device finish what it holds,
                 # flush everything else with structured rejects
-                if inflight is not None:
-                    self._collect(inflight)
-                    inflight = None
+                while pipeline:
+                    self._collect(pipeline.popleft())
                 self._ingest(block=False)
                 svc_done = svc.shutdown(drain=False)
                 for rid, doc in svc_done.items():
                     self._on_result(rid, doc, None)
                 self._gauges(inflight=0)
                 return
-            # double buffer: stage + dispatch batch k+1 BEFORE
-            # collecting batch k -- the device queue serializes them,
-            # so the device goes straight from batch k to k+1 while the
-            # host was staging
-            nxt = self._stage_next()
-            if inflight is not None:
-                self._collect(inflight)
-            inflight = nxt
-            self._gauges(inflight=int(inflight is not None))
-            if inflight is None and not svc._queues \
+            # depth-k pipeline: stage + dispatch until ``depth`` batches
+            # are in flight BEFORE collecting the oldest -- the device
+            # queue serializes them, so the device goes batch to batch
+            # while the host stages and collects in its shadow.  depth=2
+            # reproduces the ISSUE-14 double buffer event order exactly
+            # (stage k+1, collect k, stage k+2, collect k+1, ...).
+            while len(pipeline) < self.depth:
+                nxt = self._stage_next()
+                if nxt is None:
+                    break
+                pipeline.append(nxt)
+            if pipeline:
+                self._collect(pipeline.popleft())
+            self._gauges(inflight=len(pipeline))
+            if not pipeline and not svc._queues \
                     and self._qin.empty() and stopping:
                 svc.shutdown(drain=True)     # idempotent: marks core
                 self._gauges(inflight=0)

@@ -48,6 +48,16 @@ FIFO batch pop, so seeded plans replay).  :func:`run_async_shutdown_cell`
 pins ``shutdown(drain=False)`` under load: every future resolves, every
 unexecuted request gets a STRUCTURED ``serve_reject/v1`` shutdown
 reject, zero silent drops.
+
+ISSUE 19 grows a **fleet** column: :func:`run_fleet_saturation_cell`
+drives a 2-grid sync fleet into overload under a virtual clock (batch
+execution advances injected time, so deadline/shed dynamics are exact
+and replayable) and pins that every shed is STRUCTURED and attributed
+to its grid while admitted latency stays deadline-bounded and FLAT as
+overload grows; :func:`run_fleet_grid_loss_cell` poisons one member's
+executor until its breaker opens and pins that traffic RE-ROUTES to the
+healthy member (every post-loss request served there, fastpath) with
+zero silent drops and bit-identical replay.
 """
 from __future__ import annotations
 
@@ -56,7 +66,7 @@ import numpy as np
 from ..resilience.faults import (FAULT_KINDS, FaultPlan, FaultSpec,
                                  logs_identical)
 from ..redist.engine import fault_injection
-from .admission import REJECT_SCHEMA
+from .admission import REJECT_REASONS, REJECT_SCHEMA
 from .async_front import AsyncSolverService
 from .executor import residual
 from .service import SolverService
@@ -492,6 +502,288 @@ def chaos_matrix(grid, *, kinds=FAULT_KINDS, targets=CHAOS_TARGETS,
     return {"schema": CHAOS_SCHEMA, "grid": [grid.height, grid.width],
             "seed": seed, "cells": cells, "violations_total": nviol,
             "vacuous_cells": vacuous, "ok": nviol == 0}
+
+
+# ---- fleet column (ISSUE 19) ----------------------------------------
+
+class _ChaosClock:
+    """Manually advanced virtual clock for deterministic fleet cells."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += float(dt)
+        return self.t
+
+    def sleep(self, dt: float) -> None:
+        self.advance(dt)
+
+
+class _TimedExecutor:
+    """Saturation-cell executor shim: the member's REAL executor runs
+    the batch, then the shared virtual clock jumps ``batch_s`` and the
+    batch reports that duration -- so EWMA estimates, deadlines, and
+    latency ledgers all see one consistent simulated timeline regardless
+    of host speed.  Only ``run`` is shimmed: the sync service touches
+    nothing else on the executor."""
+
+    def __init__(self, inner, clock: _ChaosClock, batch_s: float):
+        self._inner = inner
+        self._clock = clock
+        self.batch_s = float(batch_s)
+
+    def run(self, bucket, reqs):
+        xs, _ = self._inner.run(bucket, reqs)
+        self._clock.advance(self.batch_s)
+        return xs, self.batch_s
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class _PoisonedExecutor:
+    """Grid-loss-cell executor shim: every batch (and every bisect
+    re-execution) on the poisoned member returns corrupted solutions, so
+    certification fails persistently and the member's breaker trips --
+    the 'grid died' stand-in.  Escalation bypasses the executor (the
+    distributed certified path), so poisoned requests still end ok."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def run(self, bucket, reqs):
+        xs, seconds = self._inner.run(bucket, reqs)
+        return [np.asarray(x) + 1.0e3 for x in xs], seconds
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def _fleet_grade(futs, workload, violations, outcomes):
+    """Shared fleet-cell grading: every future resolved, every outcome
+    structured (ok with a passing trusted residual, a known reject
+    reason, or a flagged failure/timeout).  Returns (n_ok, sheds)."""
+    n_ok = 0
+    sheds = []
+    for i, (f, (A, B)) in enumerate(zip(futs, workload)):
+        if not f.done():
+            violations.append({"kind": "silent_drop", "id": i,
+                               "detail": "future unresolved after drain"})
+            outcomes[i] = "dropped"
+            continue
+        X, doc = f.result(timeout=0)
+        if doc.get("schema") == REJECT_SCHEMA:
+            reason = doc.get("reason")
+            outcomes[i] = f"reject:{reason}:{doc.get('grid')}"
+            if reason not in REJECT_REASONS:
+                violations.append({"kind": "unstructured", "id": i,
+                                   "detail": f"unknown reject {reason!r}"})
+            sheds.append(doc)
+        elif doc.get("status") == "ok":
+            outcomes[i] = f"ok:{doc.get('grid')}:{doc.get('path')}"
+            n_ok += 1
+            if X is None or residual(A, B, X) > doc["tol"]:
+                violations.append({"kind": "silent_garbage", "id": i,
+                                   "detail": "ok result fails the trusted "
+                                             "recomputed residual"})
+        elif doc.get("status") in ("failed", "timed_out"):
+            outcomes[i] = f"{doc['status']}:{doc.get('grid')}"
+        else:
+            outcomes[i] = str(doc.get("status"))
+            violations.append({"kind": "unstructured", "id": i,
+                               "detail": f"unexpected outcome {doc!r}"})
+    return n_ok, sheds
+
+
+def run_fleet_saturation_cell(*, grids: int = 2, n: int = 16,
+                              nrhs: int = 2, max_batch: int = 4,
+                              batch_s: float = 1.0,
+                              budget_s: float | None = None,
+                              light: int = 4, overload=(16, 32),
+                              seed: int = 13):
+    """Fleet saturation: overload sheds STRUCTURED, latency stays flat.
+
+    A ``grids``-member sync fleet under a virtual clock: every executed
+    batch advances injected time by ``batch_s`` and each member's EWMA
+    is pre-seeded to it, so admission's wait estimates are exact.  One
+    light wave (no shedding expected) then rising overload waves, each
+    request carrying ``budget_s`` (default ``2.5 x batch_s``).  Pins:
+
+      * every future resolves (zero silent drops);
+      * at overload, sheds happen, every one a known structured reason
+        CARRYING THE GRID ID that made the call;
+      * the light wave sheds nothing (no vacuous over-shedding);
+      * p99 virtual latency of ``ok`` requests stays within ``budget_s
+        + 2 x batch_s`` at EVERY wave -- overload sheds instead of
+        stretching admitted tails.
+
+    Returns ``(cell_doc, fleet)``."""
+    from .fleet import SolverFleet
+    if budget_s is None:
+        budget_s = 2.5 * batch_s
+    clock = _ChaosClock()
+    fleet = SolverFleet(grids=grids, pipelined=False, max_batch=max_batch,
+                        shed=True, breaker_threshold=99, retries=0,
+                        backoff_base_s=0.0, clock=clock,
+                        sleep=clock.sleep)
+    bucket = None
+    for svc in fleet.services:
+        svc.executor = _TimedExecutor(svc.executor, clock, batch_s)
+    violations: list = []
+    outcomes: dict = {}
+    waves = []
+    total_sheds = 0
+    for wi, count in enumerate([light, *overload]):
+        workload = build_workload("hpd", n, nrhs, count, seed + wi)
+        futs = []
+        for i, (A, B) in enumerate(workload):
+            futs.append(fleet.submit("hpd", A, B, budget_s=budget_s,
+                                     tenant=f"t{i % 2}"))
+            if bucket is None and futs[-1].done() is False:
+                # seed every member's EWMA at the simulated batch rate
+                # (first wave, first admitted request fixes the bucket)
+                from .admission import make_bucket
+                bucket = make_bucket("hpd", n, nrhs, A.dtype)
+                for svc in fleet.services:
+                    svc.admission.observe_batch(bucket, batch_s)
+        fleet.drain()
+        wave_viol: list = []
+        wave_out: dict = {}
+        n_ok, sheds = _fleet_grade(futs, workload, wave_viol, wave_out)
+        for doc in sheds:
+            if doc.get("grid") is None:
+                wave_viol.append({
+                    "kind": "unstructured",
+                    "detail": f"shed {doc.get('reason')!r} without a "
+                              f"grid attribution"})
+        lat = sorted(f.result(0)[1]["latency_s"] for f in futs
+                     if f.done() and f.result(0)[1].get("status") == "ok")
+        p99 = lat[max(int(0.99 * len(lat)) - 1, 0)] if lat else 0.0
+        bound = budget_s + 2.0 * batch_s
+        if p99 > bound:
+            wave_viol.append({
+                "kind": "unstructured",
+                "detail": f"wave {count}: admitted p99 {p99:.3g}s exceeds "
+                          f"{bound:.3g}s -- overload stretched the tail "
+                          f"instead of shedding"})
+        if wi == 0 and sheds:
+            wave_viol.append({"kind": "vacuous",
+                              "detail": "light wave shed load"})
+        waves.append({"requests": count, "ok": n_ok,
+                      "sheds": len(sheds), "p99_s": p99})
+        total_sheds += len(sheds) if wi > 0 else 0
+        violations.extend(wave_viol)
+        outcomes.update({f"w{wi}:{k}": v for k, v in wave_out.items()})
+    if total_sheds == 0:
+        violations.append({"kind": "vacuous",
+                           "detail": "overload waves never shed -- the "
+                                     "saturation pin is unexercised"})
+    fleet.shutdown(drain=True)
+    return {"kind": "saturation", "target": "fleet", "mode": "overload",
+            "op": "hpd", "column": "fleet", "grids": grids,
+            "requests": light + sum(overload), "waves": waves,
+            "ok": sum(w["ok"] for w in waves), "fired": total_sheds,
+            "budget_s": budget_s, "outcomes": outcomes,
+            "verdict": "isolated" if not violations else "surfaced",
+            "violations": violations}, fleet
+
+
+def run_fleet_grid_loss_cell(*, n: int = 16, nrhs: int = 2,
+                             requests: int = 8, seed: int = 13):
+    """Grid loss: one member's breaker opens, traffic re-routes.
+
+    A 2-member sync fleet (``max_batch=2``, ``breaker_threshold=2``)
+    whose member ``g0`` gets a poisoned executor: every fast-path batch
+    it runs certifies FALSE, so two consecutive batches trip its breaker
+    while the poisoned requests recover through the distributed
+    escalation path.  The clock is virtual and never advanced, so the
+    cooldown never elapses -- ``g0`` stays lost.  Phase A routes
+    ``requests`` across both members (backlog-tie alternation) and trips
+    ``g0``; phase B submits ``requests`` more and pins that EVERY one is
+    served by ``g1`` on the fast path.  Zero silent drops, zero sheds,
+    zero silent garbage; deterministic outcome/grid/path ledger (the
+    replay oracle runs the cell twice and compares).  Returns
+    ``(cell_doc, fleet)``."""
+    from .fleet import SolverFleet
+    from .policy import OPEN
+    clock = _ChaosClock()
+    fleet = SolverFleet(grids=2, pipelined=False, max_batch=2,
+                        shed=False, breaker_threshold=2, retries=0,
+                        backoff_base_s=0.0, breaker_cooldown_s=1.0e9,
+                        clock=clock, sleep=clock.sleep)
+    fleet.services[0].executor = _PoisonedExecutor(
+        fleet.services[0].executor)
+    violations: list = []
+    outcomes: dict = {}
+    workload_a = build_workload("hpd", n, nrhs, requests, seed)
+    futs_a = [fleet.submit("hpd", A, B, tenant="a") for A, B in workload_a]
+    fleet.drain()
+    out_a: dict = {}
+    ok_a, sheds_a = _fleet_grade(futs_a, workload_a, violations, out_a)
+    outcomes.update({f"a:{k}": v for k, v in out_a.items()})
+    if ok_a != requests:
+        violations.append({
+            "kind": "collateral",
+            "detail": f"phase A: {requests - ok_a} poisoned-member "
+                      f"requests did not recover via escalation"})
+    g0_served = sum(1 for f in futs_a
+                    if f.done() and f.result(0)[1].get("grid") == "g0")
+    if g0_served == 0:
+        violations.append({"kind": "vacuous",
+                           "detail": "phase A never touched the poisoned "
+                                     "member"})
+    br0 = fleet.services[0].breakers
+    if not any(b.state == OPEN for b in br0.values()):
+        violations.append({"kind": "vacuous",
+                           "detail": "poisoned member's breaker never "
+                                     "opened -- no grid loss happened"})
+    workload_b = build_workload("hpd", n, nrhs, requests, seed + 1)
+    futs_b = [fleet.submit("hpd", A, B, tenant="b") for A, B in workload_b]
+    fleet.drain()
+    out_b: dict = {}
+    ok_b, sheds_b = _fleet_grade(futs_b, workload_b, violations, out_b)
+    outcomes.update({f"b:{k}": v for k, v in out_b.items()})
+    for i, f in enumerate(futs_b):
+        if not f.done():
+            continue
+        _, doc = f.result(timeout=0)
+        if doc.get("grid") != "g1" or doc.get("path") != "fastpath" \
+                or doc.get("status") != "ok":
+            violations.append({
+                "kind": "collateral", "id": i,
+                "detail": f"phase B request not re-routed to the healthy "
+                          f"member fast path: grid={doc.get('grid')!r} "
+                          f"path={doc.get('path')!r} "
+                          f"status={doc.get('status')!r}"})
+    if sheds_a or sheds_b:
+        violations.append({"kind": "collateral",
+                           "detail": "grid loss shed load instead of "
+                                     "re-routing it"})
+    fleet.shutdown(drain=True)
+    return {"kind": "grid_loss", "target": "fleet", "mode": "persistent",
+            "op": "hpd", "column": "fleet", "grids": 2,
+            "requests": 2 * requests, "ok": ok_a + ok_b, "fired": g0_served,
+            "budget_s": None, "outcomes": outcomes,
+            "verdict": "isolated" if not violations else "surfaced",
+            "violations": violations}, fleet
+
+
+def fleet_replay_identical(*, n: int = 16, requests: int = 8,
+                           seed: int = 29) -> bool:
+    """Run the grid-loss cell twice with the same seed: identical
+    outcome/grid/path ledgers and verdicts (the fleet's determinism
+    oracle -- sync mode is single-threaded under a virtual clock, so
+    routing, breaker trips, and escalations replay bit-identically)."""
+    c1, _ = run_fleet_grid_loss_cell(n=n, requests=requests, seed=seed)
+    c2, _ = run_fleet_grid_loss_cell(n=n, requests=requests, seed=seed)
+    same = [c1["outcomes"][k] for k in sorted(c1["outcomes"])] \
+        == [c2["outcomes"][k] for k in sorted(c2["outcomes"])]
+    return same and c1["verdict"] == c2["verdict"] \
+        and c1["ok"] == c2["ok"]
 
 
 def replay_identical(grid, *, kind: str = "bitflip",

@@ -186,25 +186,29 @@ def _bucket_dims(bucket: Bucket) -> tuple:
         else (bucket.n, bucket.n)
 
 
-def tune_token(op: str, bucket: Bucket, backend: str) -> str:
+def tune_token(op: str, bucket: Bucket, backend: str, ns: str = "") -> str:
     """Digest of the resolved tuning-cache winner for this geometry.
 
     Empty string when the mapped driver op has no cache entry (the
     common cold case -- executable keys stay byte-identical to PR 9).
     Otherwise a crc32 over the winner's config/created/source, so any
     re-sweep that changes the resolved knobs changes the executable key
-    and forces a fresh compile instead of serving a stale binary."""
+    and forces a fresh compile instead of serving a stale binary.
+    ``ns`` scopes the lookup to a fleet member's namespaced entries
+    (ISSUE 19): two pool grids can resolve DIFFERENT winners."""
     driver_op = DRIVER_OPS.get(op)
     if driver_op is None:
         return ""
     dims = _bucket_dims(bucket)
-    memo_key = (_tune.cache_dir(), driver_op, dims, bucket.dtype, backend)
+    memo_key = (_tune.cache_dir(), driver_op, dims, bucket.dtype, backend,
+                ns)
     ep = _tune.epoch()
     cached = _TOKEN_MEMO.get(memo_key)
     if cached is not None and cached[0] == ep:
         return cached[1]
     doc = _tune.load(
-        _tune.make_key(driver_op, dims, bucket.dtype, (1, 1), backend))
+        _tune.make_key(driver_op, dims, bucket.dtype, (1, 1), backend,
+                       ns=ns))
     if doc is None:
         token = ""
     else:
@@ -217,7 +221,7 @@ def tune_token(op: str, bucket: Bucket, backend: str) -> str:
 
 
 def route_for(bucket: Bucket, grid_shape, backend: str,
-              est_vmap_s: float | None):
+              est_vmap_s: float | None, ns: str = ""):
     """Tuner-fed dispatch decision for ONE request of ``bucket``.
 
     Returns ``(route, provenance)`` with route ``'vmap'`` (the batched
@@ -227,8 +231,10 @@ def route_for(bucket: Bucket, grid_shape, backend: str,
     ``grid_shape`` whose recorded seconds strictly beat the vmap path's
     per-request estimate (``est_vmap_s``, the admission EWMA / cold
     flops model) -- a missing or unmeasured entry always stays on vmap,
-    so routing is deterministic on a cold cache.  The provenance dict is
-    what ``serve_result/v1`` records as its ``dispatch`` field."""
+    so routing is deterministic on a cold cache.  ``ns`` scopes the
+    lookup to a fleet member's namespaced constants (ISSUE 19).  The
+    provenance dict is what ``serve_result/v1`` records as its
+    ``dispatch`` field."""
     driver_op = DRIVER_OPS.get(bucket.op)
     prov = {"route": "vmap", "driver_op": driver_op,
             "grid": list(grid_shape), "source": "default",
@@ -236,10 +242,10 @@ def route_for(bucket: Bucket, grid_shape, backend: str,
             "vmap_est_s": None if est_vmap_s is None else float(est_vmap_s)}
     if driver_op is None:
         return "vmap", prov
-    prov["tune_token"] = tune_token(bucket.op, bucket, backend)
+    prov["tune_token"] = tune_token(bucket.op, bucket, backend, ns=ns)
     doc = _tune.load(_tune.make_key(driver_op, _bucket_dims(bucket),
                                     bucket.dtype, tuple(grid_shape),
-                                    backend))
+                                    backend, ns=ns))
     if doc is None or doc.get("source") != "measured":
         return "vmap", prov
     prov["source"] = "measured"
@@ -270,7 +276,8 @@ class ExecutableCache:
 
     @staticmethod
     def key(op: str, bucket: Bucket, slots: int, backend: str,
-            tune: str = "", donate: bool = False) -> str:
+            tune: str = "", donate: bool = False,
+            device=None) -> str:
         if bucket.m is not None:
             geo = f"b{bucket.m}x{bucket.n}x{bucket.nrhs}"
         else:
@@ -280,26 +287,41 @@ class ExecutableCache:
             key += f"__t{tune}"
         if donate:
             key += "__donated"
+        if device is not None:
+            # fleet members pin their batches to the grid's lead device
+            # (ISSUE 19): one executable per pinned placement; the
+            # unpinned key stays byte-identical to PR 9
+            key += f"__d{device.id}"
         return key
 
     def get(self, op: str, bucket: Bucket, slots: int, *,
-            donate: bool = False):
-        """The compiled batched executable for this geometry."""
+            donate: bool = False, device=None, tune_ns: str = ""):
+        """The compiled batched executable for this geometry.
+
+        ``device`` (ISSUE 19) AOT-lowers the executable with its inputs
+        pinned to that device (``SingleDeviceSharding``), so each fleet
+        grid's batches execute on ITS devices instead of the backend
+        default; ``tune_ns`` scopes the tuner-provenance token to the
+        member's namespaced constants."""
         import jax
 
         backend = jax.default_backend()
         key = self.key(op, bucket, slots, backend,
-                       tune=tune_token(op, bucket, backend), donate=donate)
+                       tune=tune_token(op, bucket, backend, ns=tune_ns),
+                       donate=donate, device=device)
         hit = self._cache.get(key)
         if hit is not None:
             _metrics.inc("serve_exec_cache_events", op=op, event="hit")
             return hit
         _metrics.inc("serve_exec_cache_events", op=op, event="miss")
         rows = bucket.m if bucket.m is not None else bucket.n
+        sharding = None if device is None \
+            else jax.sharding.SingleDeviceSharding(device)
+        skw = {} if sharding is None else {"sharding": sharding}
         a = jax.ShapeDtypeStruct((slots, rows, bucket.n),
-                                 np.dtype(bucket.dtype))
+                                 np.dtype(bucket.dtype), **skw)
         b = jax.ShapeDtypeStruct((slots, rows, bucket.nrhs),
-                                 np.dtype(bucket.dtype))
+                                 np.dtype(bucket.dtype), **skw)
         fn = jax.jit(jax.vmap(_kernel(op)),
                      donate_argnums=(0, 1) if donate else ())
         with warnings.catch_warnings():
@@ -343,17 +365,23 @@ class Executor:
 
     ``run`` is the synchronous path (PR-9 semantics); the async front
     drives the same three stages itself so batch k+1's host staging
-    overlaps batch k's device execution."""
+    overlaps batch k's device execution.  ``device``/``tune_ns`` (ISSUE
+    19) pin a fleet member's batches to its grid's lead device and scope
+    its tuner provenance to the member's constant namespace."""
 
-    def __init__(self, *, clock=time.monotonic):
+    def __init__(self, *, clock=time.monotonic, device=None,
+                 tune_ns: str = ""):
         self.cache = ExecutableCache()
         self.clock = clock
+        self.device = device
+        self.tune_ns = str(tune_ns)
 
     def stage(self, bucket: Bucket, requests, *, donate: bool = False):
         """HOST stage: pad + stack every request, look up the executable.
 
         This is the work the async pipeline overlaps with the previous
         batch's device execution.  Returns a :class:`Staged`."""
+        import jax
         import jax.numpy as jnp
 
         t0 = self.clock()
@@ -372,10 +400,16 @@ class Executor:
             b = np.zeros((slots, bucket.n, bucket.nrhs), dtype=dt)
             for i, req in enumerate(requests):
                 a[i], b[i] = pad_problem(req.A, req.B, bucket)
-        compiled = self.cache.get(bucket.op, bucket, slots, donate=donate)
+        compiled = self.cache.get(bucket.op, bucket, slots, donate=donate,
+                                  device=self.device, tune_ns=self.tune_ns)
+        if self.device is not None:
+            sharding = jax.sharding.SingleDeviceSharding(self.device)
+            da = jax.device_put(a, sharding)
+            db = jax.device_put(b, sharding)
+        else:
+            da, db = jnp.asarray(a), jnp.asarray(b)
         staged = Staged(bucket=bucket, requests=list(requests),
-                        compiled=compiled, a=jnp.asarray(a),
-                        b=jnp.asarray(b), donate=donate)
+                        compiled=compiled, a=da, b=db, donate=donate)
         _metrics.observe("serve_stage_seconds", self.clock() - t0,
                          op=bucket.op, stage="stage")
         return staged

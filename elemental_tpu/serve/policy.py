@@ -84,27 +84,37 @@ class CircuitBreaker:
     ``record_failure`` feed it certification outcomes."""
 
     def __init__(self, bucket_key: str, *, threshold: int = 3,
-                 cooldown_s: float = 1.0, clock=time.monotonic):
+                 cooldown_s: float = 1.0, clock=time.monotonic,
+                 grid: str | None = None):
         self.bucket_key = str(bucket_key)
         self.threshold = max(int(threshold), 1)
         self.cooldown_s = float(cooldown_s)
         self.clock = clock
+        #: owning fleet member (ISSUE 19): labels the breaker metric
+        #: series per grid so one pool member tripping is attributable;
+        #: None (direct single-service) keeps the PR-9 label set
+        self.grid = grid
         self.state = CLOSED
         self.failures = 0            # consecutive certification failures
         self.opened_at: float | None = None
         self._gauge()
 
     # ---- transitions -------------------------------------------------
+    def _labels(self) -> dict:
+        if self.grid is None:
+            return {"bucket": self.bucket_key}
+        return {"bucket": self.bucket_key, "grid": self.grid}
+
     def _gauge(self) -> None:
         _metrics.set_gauge("serve_breaker_state", _STATE_GAUGE[self.state],
-                           bucket=self.bucket_key)
+                           **self._labels())
 
     def _transition(self, state: str) -> None:
         if state == self.state:
             return
         self.state = state
-        _metrics.inc("serve_breaker_transitions", bucket=self.bucket_key,
-                     to=state)
+        _metrics.inc("serve_breaker_transitions", to=state,
+                     **self._labels())
         self._gauge()
 
     def allow(self) -> bool:

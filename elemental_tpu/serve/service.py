@@ -61,6 +61,9 @@ class SolverService:
                  escalate_nb: int | None = None, tol_factor: float = 1.0,
                  flops_per_s: float | None = None,
                  hbm_bytes: float | None = None,
+                 pipeline_depth: int = 2,
+                 name: str | None = None, tune_ns: str = "",
+                 device=None,
                  clock=time.monotonic, sleep=None):
         self.grid = grid
         self.max_batch = max(int(max_batch), 1)
@@ -72,14 +75,23 @@ class SolverService:
         self.degrade_pressure = float(degrade_pressure)
         self.escalate_nb = escalate_nb
         self.tol_factor = float(tol_factor)
+        #: fleet identity (ISSUE 19): ``name`` labels this member's
+        #: metric series and stamps its result/reject docs; ``tune_ns``
+        #: namespaces its tuner constants; ``device`` pins its batch
+        #: executables.  All default off -- a direct SolverService keeps
+        #: PR-9 semantics (unlabeled gauges, ``grid: None`` in docs).
+        self.name = name
+        self.tune_ns = str(tune_ns)
         self.clock = clock
         self._sleep = sleep if sleep is not None else time.sleep
         kw = {} if flops_per_s is None else {"flops_per_s": flops_per_s}
         if hbm_bytes is not None:
             kw["hbm_bytes"] = hbm_bytes
         self.admission = AdmissionController(
-            shed=shed, max_batch=self.max_batch, clock=clock, **kw)
-        self.executor = Executor(clock=clock)
+            shed=shed, max_batch=self.max_batch, clock=clock,
+            pipeline_depth=pipeline_depth, grid=name, **kw)
+        self.executor = Executor(clock=clock, device=device,
+                                 tune_ns=self.tune_ns)
         self.retry = RetryPolicy(retries=retries, base_s=backoff_base_s,
                                  seed=seed)
         self.breakers: dict = {}         # bucket.key() -> CircuitBreaker
@@ -106,7 +118,8 @@ class SolverService:
         if br is None:
             br = self.breakers[bucket.key()] = CircuitBreaker(
                 bucket.key(), threshold=self.breaker_threshold,
-                cooldown_s=self.breaker_cooldown_s, clock=self.clock)
+                cooldown_s=self.breaker_cooldown_s, clock=self.clock,
+                grid=self.name)
         return br
 
     def queue_depth(self, bucket: Bucket | None = None) -> int:
@@ -119,8 +132,16 @@ class SolverService:
         return self.queue_depth() / self.capacity
 
     def _gauges(self) -> None:
-        _metrics.set_gauge("serve_queue_depth", self.queue_depth())
-        _metrics.set_gauge("serve_pressure", self.pressure())
+        if self.name is None:
+            _metrics.set_gauge("serve_queue_depth", self.queue_depth())
+            _metrics.set_gauge("serve_pressure", self.pressure())
+        else:
+            # fleet members label their series per grid (ISSUE 19) so
+            # the pool's gauges do not clobber each other
+            _metrics.set_gauge("serve_queue_depth", self.queue_depth(),
+                               grid=self.name)
+            _metrics.set_gauge("serve_pressure", self.pressure(),
+                               grid=self.name)
 
     def _tol(self, req) -> float:
         return self.tol_factor * default_tol(req.n, req.A.dtype)
@@ -133,24 +154,29 @@ class SolverService:
         est = self.admission.estimate_batch_s(bucket) / self.max_batch
         g = self._grid()
         return route_for(bucket, (g.height, g.width),
-                         jax.default_backend(), est)
+                         jax.default_backend(), est, ns=self.tune_ns)
 
     # ---- submit ------------------------------------------------------
     def submit(self, op: str, A, B, *, budget_s: float | None = None,
-               deadline: Deadline | None = None):
+               deadline: Deadline | None = None,
+               tenant: str | None = None):
         """Admit one request.  Returns the request id (int) on accept or
         a structured ``serve_reject/v1`` dict on fast reject (load shed,
-        expired deadline, open breaker, malformed request)."""
+        expired deadline, open breaker, malformed request).  ``tenant``
+        rides into the result/reject documents (the fleet path, ISSUE
+        19; quota enforcement itself lives in the fleet scheduler)."""
         if deadline is None and budget_s is not None:
             deadline = Deadline(budget_s, clock=self.clock)
         if self._shutdown:
             rej = reject_doc("shutdown", queue_depth=self.queue_depth(),
-                             deadline=deadline,
+                             deadline=deadline, grid=self.name,
+                             tenant=tenant,
                              detail="service has shut down")
             _metrics.inc("serve_rejects", reason="shutdown")
             return rej
         req = self.admission.admit(op, A, B, deadline=deadline,
-                                   queue_depth=self.queue_depth)
+                                   queue_depth=self.queue_depth,
+                                   tenant=tenant)
         if isinstance(req, dict):        # bad_request / expired / shed
             _metrics.inc("serve_rejects", reason=req["reason"])
             return req
@@ -164,7 +190,8 @@ class SolverService:
             if not elapsed_ok:
                 rej = reject_doc("breaker_open", bucket=bucket,
                                  queue_depth=self.queue_depth(bucket),
-                                 deadline=deadline,
+                                 deadline=deadline, grid=self.name,
+                                 tenant=tenant,
                                  detail=f"breaker open for {bucket.key()}")
                 _metrics.inc("serve_rejects", reason="breaker_open")
                 return rej
@@ -229,11 +256,19 @@ class SolverService:
             for req in self._queues[bucket]:
                 rej = reject_doc("shutdown", bucket=bucket,
                                  queue_depth=0, deadline=req.deadline,
+                                 grid=self.name, tenant=req.tenant,
                                  detail="flushed by shutdown(drain=False)")
                 rej["id"] = req.id
                 self.results[req.id] = rej
                 done[req.id] = rej
                 _metrics.inc("serve_rejects", reason="shutdown")
+                if self.on_result is not None:
+                    # flushed requests are completions too: a front
+                    # holding futures for them must see them resolve
+                    try:
+                        self.on_result(req.id, rej, None)
+                    except Exception:
+                        _metrics.inc("serve_callback_errors", op=req.op)
         self._queues.clear()
         self._shutdown = True
         self._gauges()
@@ -483,14 +518,22 @@ class SolverService:
                if req.deadline is not None else None,
                "certificate": certificate,
                "breaker": self.breaker(bucket).state,
-               "dispatch": self._dispatch.pop(req.id, None)}
+               "dispatch": self._dispatch.pop(req.id, None),
+               "grid": self.name, "tenant": req.tenant}
         self.results[req.id] = doc
         x_out = x if status == "ok" else None
         if x_out is not None:
             self.solutions[req.id] = x_out
         _metrics.inc("serve_requests", op=req.op, status=status)
-        _metrics.observe("serve_latency_seconds", float(latency),
-                         op=req.op)
+        if self.name is None:
+            _metrics.observe("serve_latency_seconds", float(latency),
+                             op=req.op)
+        else:
+            _metrics.observe("serve_latency_seconds", float(latency),
+                             op=req.op, grid=self.name)
+        if req.tenant is not None:
+            _metrics.observe("serve_tenant_latency_seconds", float(latency),
+                             tenant=req.tenant)
         if self.on_result is not None:
             try:
                 self.on_result(req.id, doc, x_out)

@@ -81,19 +81,28 @@ class CacheKey:
     dtype: str
     grid_shape: tuple
     backend: str
+    #: optional namespace (ISSUE 19): a fleet member's tuner constants
+    #: live under its own prefix so two same-shaped grids in one pool
+    #: can hold DIFFERENT measured winners (e.g. one grid re-swept after
+    #: a breaker trip).  Filename-only -- the document body is unchanged
+    #: and an un-namespaced reader never sees namespaced entries.
+    ns: str = ""
 
     def filename(self) -> str:
         b = "x".join(str(d) for d in self.bucket)
         r, c = self.grid_shape
-        return f"{self.op}__b{b}__{self.dtype}__g{r}x{c}__{self.backend}.json"
+        base = f"{self.op}__b{b}__{self.dtype}__g{r}x{c}__{self.backend}.json"
+        return f"{self.ns}__{base}" if self.ns else base
 
     def path(self) -> str:
         return os.path.join(cache_dir(), self.filename())
 
 
-def make_key(op: str, dims, dtype: str, grid_shape, backend: str) -> CacheKey:
+def make_key(op: str, dims, dtype: str, grid_shape, backend: str,
+             ns: str = "") -> CacheKey:
     return CacheKey(op=op, bucket=shape_bucket(dims), dtype=str(dtype),
-                    grid_shape=tuple(grid_shape), backend=str(backend))
+                    grid_shape=tuple(grid_shape), backend=str(backend),
+                    ns=str(ns))
 
 
 #: in-process fallback entries (keyed by filename) for sessions whose

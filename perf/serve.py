@@ -34,6 +34,17 @@ The command-line face of ``elemental_tpu/serve``:
                                             #   hard-stop flush):
                                             #   chaos_report/v1 on stdout,
                                             #   exit 1 on any violation
+    python -m perf.serve fleet-smoke        # the tools/check.sh fleet
+                                            #   gate (ISSUE 19): 2-grid
+                                            #   CPU-mesh fleet --
+                                            #   pipelined multi-tenant
+                                            #   serving with grid/tenant
+                                            #   provenance, structured
+                                            #   quota rejects, grid-loss
+                                            #   re-routing (replayed
+                                            #   bit-identically), and
+                                            #   saturation shedding with
+                                            #   flat admitted latency
 
 Runs are CPU-safe (same virtual 8-device mesh as ``perf.trace``);
 float32 workloads so certification tolerances match the unforced-x64
@@ -190,6 +201,81 @@ def cmd_smoke() -> int:
     return rc
 
 
+def cmd_fleet_smoke(seed) -> int:
+    """The check.sh fleet gate (ISSUE 19): partition the virtual mesh
+    into a 2-grid fleet and pin the four contracts end to end --
+    (1) pipelined multi-tenant serving uses BOTH members and stamps
+    grid/tenant provenance into every doc, with a clean shutdown;
+    (2) tenant quotas reject structurally (``reason='quota'``);
+    (3) the grid-loss chaos cell re-routes around an opened member,
+    replayed bit-identically; (4) the saturation cell sheds structurally
+    with flat admitted latency."""
+    import threading
+    import numpy as np
+    from elemental_tpu.serve import SolverFleet, TenantQuota
+    from elemental_tpu.serve.chaos import (fleet_replay_identical,
+                                           run_fleet_grid_loss_cell,
+                                           run_fleet_saturation_cell)
+    rc = 0
+    # leg 1: pipelined 2-grid fleet, two tenants, full provenance
+    fleet = SolverFleet(grids=2, depth=2, shed=False)
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(16):
+        ni = 24
+        F = rng.normal(size=(ni, ni)).astype(np.float32)
+        A = (F @ F.T / ni + ni * np.eye(ni)).astype(np.float32)
+        B = rng.normal(size=(ni, 2)).astype(np.float32)
+        reqs.append((A, B, f"t{i % 2}"))
+    futs = [fleet.submit("hpd", A, B, tenant=t) for A, B, t in reqs]
+    outs = [f.result(timeout=300.0) for f in futs]
+    fleet.shutdown(drain=True)
+    ok = sum(d["status"] == "ok" for _, d in outs)
+    grids_used = {d["grid"] for _, d in outs}
+    tenants = {d["tenant"] for _, d in outs}
+    leak = any(t.name == "elemental-serve-worker" and t.is_alive()
+               for t in threading.enumerate())
+    print(f"# fleet smoke pipelined: ok={ok}/16 grids={sorted(grids_used)} "
+          f"tenants={sorted(tenants)} leak={leak}")
+    if ok != 16 or grids_used != {"g0", "g1"} \
+            or tenants != {"t0", "t1"} or leak:
+        rc = 1
+    # leg 2: max_outstanding quota rejects fast and structured
+    fleet = SolverFleet(grids=2, pipelined=False, shed=False,
+                        quotas={"q": TenantQuota(max_outstanding=4)})
+    futs = [fleet.submit("hpd", A, B, tenant="q") for A, B, _ in reqs[:8]]
+    quota_rej = [f.result(timeout=0)[1] for f in futs if f.done()
+                 and f.result(timeout=0)[1].get("reason") == "quota"]
+    fleet.drain()
+    fleet.shutdown(drain=True)
+    served = sum(1 for f in futs
+                 if f.result(timeout=0)[1].get("status") == "ok")
+    print(f"# fleet smoke quota: served={served} rejects={len(quota_rej)}")
+    if len(quota_rej) != 4 or served != 4 \
+            or any(d.get("tenant") != "q" for d in quota_rej):
+        rc = 1
+    # leg 3: grid loss re-routes, bit-identical replay
+    cell, _ = run_fleet_grid_loss_cell(seed=seed + 7)
+    replay = fleet_replay_identical(seed=seed + 7)
+    print(f"# fleet smoke grid-loss: verdict={cell['verdict']} "
+          f"ok={cell['ok']}/{cell['requests']} replay={replay}")
+    if cell["violations"] or not replay:
+        for v in cell["violations"]:
+            print(f"#   violation: {v}")
+        rc = 1
+    # leg 4: saturation sheds structurally, admitted latency flat
+    cell, _ = run_fleet_saturation_cell(seed=seed + 11)
+    sheds = sum(w["sheds"] for w in cell["waves"])
+    print(f"# fleet smoke saturation: verdict={cell['verdict']} "
+          f"waves={cell['waves']}")
+    if cell["violations"] or sheds == 0:
+        for v in cell["violations"]:
+            print(f"#   violation: {v}")
+        rc = 1
+    print("# fleet smoke:", "ok" if rc == 0 else "FAILED")
+    return rc
+
+
 def cmd_chaos(seed) -> int:
     from elemental_tpu.serve import chaos_matrix, replay_identical
     grid = _grid("2x2")
@@ -213,7 +299,7 @@ def main(argv=None) -> int:
         print(__doc__)
         return 0
     cmd = argv.pop(0)
-    if cmd not in ("run", "smoke", "chaos"):
+    if cmd not in ("run", "smoke", "chaos", "fleet-smoke"):
         print(__doc__)
         raise SystemExit(f"unknown command {cmd!r}")
     requests, n, budget = 16, 64, None
@@ -246,6 +332,8 @@ def main(argv=None) -> int:
         return cmd_smoke()
     if cmd == "chaos":
         return cmd_chaos(seed)
+    if cmd == "fleet-smoke":
+        return cmd_fleet_smoke(seed)
     fspecs = tuple(_parse_fault(s) for s in faults)
     return cmd_run(requests, n, grid_spec, budget, fspecs, seed, fastpath)
 

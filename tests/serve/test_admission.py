@@ -182,6 +182,10 @@ def test_estimate_cold_then_ewma():
 def test_reject_doc_schema_pin():
     doc = adm.reject_doc("queue_pressure", queue_depth=2, estimate_s=1.5)
     assert set(doc) == {"schema", "reason", "bucket", "queue_depth",
-                        "estimate_s", "deadline", "detail"}
+                        "estimate_s", "deadline", "detail",
+                        "grid", "tenant"}
+    # single-service rejects carry the fleet fields as None (ISSUE 19):
+    # absent grid == not fleet-routed, absent tenant == direct caller
+    assert doc["grid"] is None and doc["tenant"] is None
     with pytest.raises(ValueError):
         adm.reject_doc("bogus_reason")

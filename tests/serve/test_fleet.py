@@ -1,0 +1,250 @@
+"""SolverFleet (ISSUE 19 tentpole): device partitioning into isolated
+members, deterministic load-aware routing with grid/tenant provenance,
+quota enforcement at submit, and zero-silent-drop shutdown in both the
+sync (chaos) and pipelined modes."""
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from elemental_tpu.serve import (REJECT_SCHEMA, DEFAULT_TENANT,
+                                 SolverFleet, TenantQuota,
+                                 partition_devices)
+
+from .conftest import spd
+
+
+def _workload(rng, count, n=12, nrhs=2):
+    return [(spd(rng, n), rng.normal(size=(n, nrhs)))
+            for _ in range(count)]
+
+
+def _no_leak():
+    return not any(t.name == "elemental-serve-worker" and t.is_alive()
+                   for t in threading.enumerate())
+
+
+# ---- partitioning ------------------------------------------------------
+
+def test_partition_equal_split():
+    parts = partition_devices(grids=2)
+    devs = jax.devices()
+    assert len(parts) == 2
+    assert [d for p in parts for d in p] == devs  # consecutive, disjoint
+    assert len(parts[0]) == len(parts[1]) == len(devs) // 2
+
+
+def test_partition_explicit_sizes_leave_leftovers():
+    parts = partition_devices(grids=[4, 2])
+    assert [len(p) for p in parts] == [4, 2]
+    flat = [d for p in parts for d in p]
+    assert len(set(flat)) == 6  # 2 devices deliberately unused
+
+
+def test_partition_errors():
+    with pytest.raises(ValueError):
+        partition_devices(grids=3)           # 3 does not divide 8
+    with pytest.raises(ValueError):
+        partition_devices(grids=[8, 8])      # more than available
+    with pytest.raises(ValueError):
+        partition_devices(grids=[4, 0])      # degenerate member
+
+
+# ---- member isolation --------------------------------------------------
+
+def test_members_are_isolated():
+    """Each member owns its name, tuner namespace, executor cache, and
+    breaker table -- nothing shared, so one member's state cannot bleed
+    into another's."""
+    fleet = SolverFleet(grids=2, pipelined=False, shed=False)
+    try:
+        a, b = fleet.services
+        assert (a.name, b.name) == ("g0", "g1")
+        assert a.tune_ns != b.tune_ns
+        assert a.executor is not b.executor
+        assert a.executor.cache is not b.executor.cache
+        assert a.breakers is not b.breakers
+        assert a.admission is not b.admission
+        assert not set(a.grid.devices) & set(b.grid.devices)
+    finally:
+        fleet.shutdown(drain=True)
+
+
+# ---- sync routing + provenance -----------------------------------------
+
+def test_sync_roundtrip_provenance():
+    """Submit/drain through a 2-member sync fleet: every future
+    resolves ok, docs carry the member that served them and the billing
+    tenant, and solutions pass an independent residual check."""
+    rng = np.random.default_rng(71)
+    work = _workload(rng, 8)
+    fleet = SolverFleet(grids=2, pipelined=False, shed=False, max_batch=2)
+    try:
+        futs = [fleet.submit("hpd", A, B) for A, B in work]
+        fleet.drain()
+        grids = set()
+        for f, (A, B) in zip(futs, work):
+            assert f.done()
+            X, doc = f.result(timeout=0)
+            assert doc["status"] == "ok"
+            assert doc["grid"] in ("g0", "g1") and doc["grid"] == f.grid
+            assert doc["tenant"] == DEFAULT_TENANT
+            grids.add(doc["grid"])
+            r = np.linalg.norm(A @ np.asarray(X) - B)
+            assert r / np.linalg.norm(B) < 1e-6
+        # backlog-tie alternation spreads an even workload
+        assert grids == {"g0", "g1"}
+        assert sorted(f.fleet_id for f in futs) == list(range(8))
+        assert set(fleet.results) == set(range(8))
+    finally:
+        fleet.shutdown(drain=True)
+
+
+def test_routing_balances_even_load():
+    """Equal-cost requests against cold (equal) latency estimates split
+    evenly across members via the deterministic backlog tie-break."""
+    rng = np.random.default_rng(72)
+    fleet = SolverFleet(grids=2, pipelined=False, shed=False, max_batch=4)
+    try:
+        futs = [fleet.submit("hpd", A, B) for A, B in _workload(rng, 8)]
+        fleet.drain()
+        by_grid = {}
+        for f in futs:
+            by_grid[f.grid] = by_grid.get(f.grid, 0) + 1
+        assert by_grid == {"g0": 4, "g1": 4}
+    finally:
+        fleet.shutdown(drain=True)
+
+
+# ---- tenant quotas -----------------------------------------------------
+
+def test_quota_rejects_structured_and_released():
+    """max_outstanding=2 draws 'quota' rejects for the overflow, billed
+    to the right tenant, BEFORE anything queues; slots free once the
+    tenant's work settles."""
+    rng = np.random.default_rng(73)
+    fleet = SolverFleet(grids=2, pipelined=False, shed=False,
+                        quotas={"q": TenantQuota(max_outstanding=2)})
+    try:
+        futs = [fleet.submit("hpd", A, B, tenant="q")
+                for A, B in _workload(rng, 5)]
+        rejects = [f for f in futs if f.done()]
+        assert len(rejects) == 3
+        for f in rejects:
+            _, doc = f.result(timeout=0)
+            assert doc["schema"] == REJECT_SCHEMA
+            assert doc["reason"] == "quota"
+            assert doc["tenant"] == "q"
+            assert doc["grid"] is None          # rejected before routing
+        fleet.drain()
+        assert all(f.result(0)[1]["status"] == "ok"
+                   for f in futs if f not in rejects)
+        # settled work released the quota slots
+        f2 = fleet.submit("hpd", *_workload(rng, 1)[0], tenant="q")
+        assert not f2.done()
+        fleet.drain()
+        assert f2.result(0)[1]["status"] == "ok"
+    finally:
+        fleet.shutdown(drain=True)
+
+
+def test_bad_request_rejects_with_tenant():
+    fleet = SolverFleet(grids=2, pipelined=False, shed=False)
+    try:
+        f = fleet.submit("hpd", np.eye(4), np.zeros((5, 1)), tenant="t")
+        assert f.done()
+        _, doc = f.result(timeout=0)
+        assert doc["schema"] == REJECT_SCHEMA
+        assert doc["reason"] == "bad_request"
+        assert doc["tenant"] == "t"
+    finally:
+        fleet.shutdown(drain=True)
+
+
+def test_memory_pressure_routes_around_then_rejects_with_grid_id():
+    """Grid-local HBM budgets: a member whose budget cannot fit the
+    bucket's static peak sheds what its pool-mate still admits --
+    traffic routes around it -- and when EVERY member is over budget the
+    reject is structured ``memory_pressure`` carrying a grid id."""
+    rng = np.random.default_rng(76)
+    work = _workload(rng, 4)
+    fleet = SolverFleet(grids=2, pipelined=False, max_batch=2)
+    try:
+        fleet.services[0].admission.hbm_bytes = 1.0   # g0 cannot fit it
+        futs = [fleet.submit("hpd", A, B) for A, B in work]
+        fleet.drain()
+        for f in futs:
+            _, doc = f.result(timeout=0)
+            assert doc["status"] == "ok" and doc["grid"] == "g1"
+        fleet.services[1].admission.hbm_bytes = 1.0   # now nobody can
+        f = fleet.submit("hpd", *_workload(rng, 1)[0])
+        assert f.done()
+        _, doc = f.result(timeout=0)
+        assert doc["schema"] == REJECT_SCHEMA
+        assert doc["reason"] == "memory_pressure"
+        assert doc["grid"] in ("g0", "g1")
+    finally:
+        fleet.shutdown(drain=True)
+
+
+# ---- shutdown ----------------------------------------------------------
+
+def test_shutdown_flush_resolves_every_future():
+    """shutdown(drain=False) flushes scheduler-held work as structured
+    shutdown rejects and emergency-stops members: zero silent drops."""
+    rng = np.random.default_rng(74)
+    fleet = SolverFleet(grids=2, pipelined=False, shed=False, max_batch=2)
+    futs = [fleet.submit("hpd", A, B) for A, B in _workload(rng, 8)]
+    fleet.shutdown(drain=False)
+    assert all(f.done() for f in futs)
+    reasons = set()
+    for f in futs:
+        _, doc = f.result(timeout=0)
+        if doc.get("schema") == REJECT_SCHEMA:
+            reasons.add(doc["reason"])
+            assert doc["tenant"] == DEFAULT_TENANT
+    assert reasons <= {"shutdown"}
+    # post-shutdown submits reject-fast, and shutdown is idempotent
+    f = fleet.submit("hpd", *_workload(rng, 1)[0])
+    assert f.done() and f.result(0)[1]["reason"] == "shutdown"
+    fleet.shutdown(drain=False)
+
+
+# ---- pipelined mode ----------------------------------------------------
+
+def test_pipelined_end_to_end_no_leak():
+    """Depth-2 pipelined members: every future resolves ok with grid +
+    tenant provenance; shutdown drains and leaks no worker thread."""
+    rng = np.random.default_rng(75)
+    work = _workload(rng, 6)
+    fleet = SolverFleet(grids=2, depth=2, shed=False, max_batch=2)
+    futs = [fleet.submit("hpd", A, B, tenant=f"t{i % 2}")
+            for i, (A, B) in enumerate(work)]
+    outs = [f.result(timeout=300.0) for f in futs]
+    fleet.shutdown(drain=True)
+    for i, ((X, doc), (A, B)) in enumerate(zip(outs, work)):
+        assert doc["status"] == "ok"
+        assert doc["grid"] in ("g0", "g1")
+        assert doc["tenant"] == f"t{i % 2}"
+        r = np.linalg.norm(A @ np.asarray(X) - B)
+        assert r / np.linalg.norm(B) < 1e-6
+    assert _no_leak()
+
+
+# ---- introspection -----------------------------------------------------
+
+def test_stats_snapshot_shape():
+    fleet = SolverFleet(grids=2, pipelined=False, shed=False)
+    try:
+        s = fleet.stats()
+        assert [m["grid"] for m in s["members"]] == ["g0", "g1"]
+        for m in s["members"]:
+            assert m["devices"] == len(jax.devices()) // 2
+            assert m["outstanding"] == 0
+            assert m["capacity"] == fleet.max_batch
+        assert s["scheduler"]["tenants"] == []
+        assert s["tenants_outstanding"] == {}
+        assert s["pipelined"] is False
+    finally:
+        fleet.shutdown(drain=True)

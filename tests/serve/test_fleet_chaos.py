@@ -1,0 +1,46 @@
+"""Fleet chaos column (ISSUE 19): saturation sheds structured and keeps
+admitted tails flat; grid loss re-routes to the healthy member with zero
+sheds; both replay bit-identically under the virtual clock."""
+from elemental_tpu.serve import (fleet_replay_identical,
+                                 run_fleet_grid_loss_cell,
+                                 run_fleet_saturation_cell)
+
+
+def test_saturation_sheds_structured_latency_flat():
+    doc, fleet = run_fleet_saturation_cell()
+    assert doc["violations"] == []
+    assert doc["verdict"] == "isolated"
+    assert doc["column"] == "fleet" and doc["grids"] == 2
+    # the overload waves actually shed, every shed grid-attributed
+    assert doc["fired"] > 0
+    sheds = [v for v in doc["outcomes"].values()
+             if v.startswith("reject:")]
+    assert len(sheds) == doc["fired"]
+    assert all(v.split(":")[2] in ("g0", "g1") for v in sheds)
+    # the light wave shed nothing; admitted p99 never stretched
+    assert doc["waves"][0]["sheds"] == 0
+    bound = doc["budget_s"] + 2.0
+    assert all(w["p99_s"] <= bound for w in doc["waves"])
+    # shedding rises with offered load
+    assert doc["waves"][-1]["sheds"] > doc["waves"][1]["sheds"]
+
+
+def test_grid_loss_reroutes_without_drops():
+    doc, fleet = run_fleet_grid_loss_cell()
+    assert doc["violations"] == []
+    assert doc["verdict"] == "isolated"
+    # every request (both phases) ended ok -- the poisoned member's
+    # work recovered through escalation, nothing shed, nothing dropped
+    assert doc["ok"] == doc["requests"]
+    assert doc["fired"] > 0              # phase A really touched g0
+    phase_b = [v for k, v in doc["outcomes"].items()
+               if k.startswith("b:")]
+    assert phase_b and all(v == "ok:g1:fastpath" for v in phase_b)
+    # the lost member's breaker is OPEN in the surviving fleet handle
+    from elemental_tpu.serve import OPEN
+    assert any(b.state == OPEN
+               for b in fleet.services[0].breakers.values())
+
+
+def test_fleet_replay_bit_identical():
+    assert fleet_replay_identical()

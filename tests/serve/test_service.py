@@ -49,7 +49,10 @@ def test_result_schema_pin(grid24):
     assert set(doc) == {"schema", "id", "op", "n", "nrhs", "bucket",
                         "status", "path", "rung", "residual", "tol",
                         "retries", "bisected", "timed_out", "latency_s",
-                        "deadline", "certificate", "breaker", "dispatch"}
+                        "deadline", "certificate", "breaker", "dispatch",
+                        "grid", "tenant"}
+    # fleet provenance (ISSUE 19): None on a direct single service
+    assert doc["grid"] is None and doc["tenant"] is None
     assert doc["bucket"] == "lu__b8x1__float64"
     assert doc["deadline"] is None and doc["certificate"] is None
     assert doc["breaker"] == "closed"

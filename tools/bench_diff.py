@@ -71,6 +71,7 @@ DEFAULT_METRICS = ("vs_baseline", "lu_vs_baseline",
                    "gemm_tall_skinny_tflops_per_chip",
                    "serve_p99_ms", "serve_solves_per_sec",
                    "serve_async_p99_ms", "serve_async_solves_per_sec",
+                   "serve_fleet_p99_ms", "serve_fleet_solves_per_sec",
                    "redist_p2p_gbps")
 DEFAULT_THRESHOLD = 0.10
 
@@ -85,13 +86,16 @@ DEFAULT_PER_METRIC = {"lu_n32768_tflops_per_chip": 0.25,
                       "serve_solves_per_sec": 0.25,
                       "serve_async_p99_ms": 0.25,
                       "serve_async_solves_per_sec": 0.25,
+                      "serve_fleet_p99_ms": 0.25,
+                      "serve_fleet_solves_per_sec": 0.25,
                       "redist_p2p_gbps": 0.40}
 
 #: metrics where SMALLER is better (latency percentiles from
 #: bench_serve.py): the gate inverts -- best baseline is the MINIMUM and
 #: a regression is ``current > (1 + threshold) * best``.
 LOWER_IS_BETTER = {"serve_p50_ms", "serve_p99_ms",
-                   "serve_async_p50_ms", "serve_async_p99_ms"}
+                   "serve_async_p50_ms", "serve_async_p99_ms",
+                   "serve_fleet_p50_ms", "serve_fleet_p99_ms"}
 
 _ROUND_RE = re.compile(r"_r(\d+)\.json$")
 

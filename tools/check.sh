@@ -32,6 +32,11 @@
 #                             #   {redistribute,compute} x {oneshot,
 #                             #   persistent} + the qr op column), the
 #                             #   bench_serve schema smoke, and tests/serve
+#   tools/check.sh fleet      # solver-fleet gate (ISSUE 19): fleet-smoke
+#                             #   (pipelined multi-grid routing, tenant
+#                             #   quota rejects, grid-loss + saturation
+#                             #   chaos cells with replay) and the fleet
+#                             #   scheduler/routing/fairness/chaos tests
 #   tools/check.sh abft       # ABFT gate (ISSUE 11): checksum-guarded
 #                             #   lu/cholesky smoke (clean 1x1 + 2x2, zero
 #                             #   violations; injected faults recovered at
@@ -342,6 +347,16 @@ if [ "$what" = "all" ] || [ "$what" = "serve" ]; then
     JAX_PLATFORMS=cpu python bench_serve.py --smoke > /dev/null || rc=1
     echo "== serve tier-1 tests (admission/executor/policy/service/chaos) =="
     python -m pytest tests/serve -q -m 'not slow' -p no:cacheprovider || rc=1
+fi
+
+if [ "$what" = "all" ] || [ "$what" = "fleet" ]; then
+    echo "== solver-fleet smoke (multi-grid routing, quota, chaos cells) =="
+    JAX_PLATFORMS=cpu python -m perf.serve fleet-smoke || rc=1
+    echo "== fleet tier-1 tests (scheduler/routing/fairness/chaos) =="
+    python -m pytest tests/serve/test_fleet.py \
+        tests/serve/test_fleet_fairness.py \
+        tests/serve/test_fleet_chaos.py \
+        -q -m 'not slow' -p no:cacheprovider || rc=1
 fi
 
 if [ "$rc" -eq 0 ]; then
