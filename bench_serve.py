@@ -17,7 +17,15 @@ Prints ONE JSON line (``bench_serve/v1``)::
      "serve_fleet_ok": ..., "serve_fleet_n": ...,
      "serve_fleet_grids_used": ["g0", "g1"], "serve_fleet_scaling": ...,
      "serve_fleet_busy_single_s": ..., "serve_fleet_busy_per_grid_s":
-     [...], "serve_fleet_scaling_ok": ...}
+     [...], "serve_fleet_scaling_ok": ...,
+     "serve_slo_p99_ms": ..., "serve_slo": {serve_slo/v1 doc}}
+
+The ``serve_slo_*`` keys (ISSUE 20) come from the fleet's windowed
+:class:`~elemental_tpu.obs.slo.SLOMonitor`: ``serve_slo`` is the full
+``serve_slo/v1`` snapshot of the measured fleet pass (per-tenant/grid/
+bucket percentiles, error/shed rates, burn rates) and
+``serve_slo_p99_ms`` the worst per-tenant windowed p99 -- the single
+scalar ``tools/bench_diff.py`` gates lower-is-better.
 
 into the BENCH flow: ``tools/bench_diff.py`` gates ``serve_p99_ms`` /
 ``serve_async_p99_ms`` / ``serve_fleet_p99_ms`` (lower-is-better) and
@@ -133,7 +141,7 @@ def run_bench(requests: int, n: int, grid_spec, seed: int) -> dict:
                        in reg2.counters("serve_batches").items())
     stats = front.pipeline_stats()
     front.shutdown(drain=True)
-    leak = any(t.name == "elemental-serve-worker" and t.is_alive()
+    leak = any(t.name.startswith("elemental-serve-worker") and t.is_alive()
                for t in threading.enumerate())
 
     # bit-identical payloads: same solutions, same serve_result/v1
@@ -279,6 +287,11 @@ def run_fleet_bench(requests: int, n: int, seed: int) -> dict:
     lats = sorted(d["latency_s"] for _, d in outs)
     ok = sum(d["status"] == "ok" for _, d in outs)
     grids_used = sorted({d["grid"] for _, d in outs})
+    # windowed SLO view of the measured pass (ISSUE 20): the fleet's
+    # monitor saw every settled doc; the worst per-tenant p99 is the
+    # gateable scalar, the full serve_slo/v1 snapshot rides along
+    slo_doc = fleet.slo.snapshot(gauges=False, source="bench_serve")
+    slo_p99 = fleet.slo.worst_p99_ms()
 
     # device-busy scaling: the same workload through sync fleets of 1
     # and 2 grids over the SAME total device set, each warmed, each
@@ -336,6 +349,8 @@ def run_fleet_bench(requests: int, n: int, seed: int) -> dict:
         "serve_fleet_busy_single_s": sum(busy1),
         "serve_fleet_busy_per_grid_s": busy2,
         "serve_fleet_scaling_ok": int(ok1) + int(ok2),
+        "serve_slo_p99_ms": slo_p99,
+        "serve_slo": slo_doc,
     }
 
 
@@ -381,9 +396,15 @@ def main(argv=None) -> int:
                            "serve_pipeline_occupancy",
                            "serve_fleet_p50_ms", "serve_fleet_p99_ms",
                            "serve_fleet_solves_per_sec",
-                           "serve_fleet_scaling")
+                           "serve_fleet_scaling", "serve_slo_p99_ms")
                if not isinstance(doc.get(k), (int, float))]
         contract = []
+        slo_tenants = {r["tenant"]
+                       for r in (doc.get("serve_slo") or {}).get("series",
+                                                                 ())}
+        if not {"t0", "t1"} <= slo_tenants:
+            contract.append(f"SLO snapshot missing tenants "
+                            f"(saw {sorted(slo_tenants)})")
         if doc["serve_fleet_ok"] != doc["serve_fleet_requests"]:
             contract.append("fleet requests not all ok")
         if doc["serve_fleet_grids_used"] != ["g0", "g1"]:

@@ -15,28 +15,49 @@ Four pieces, one subsystem -- the layer every perf PR reports through:
                        (``phase_timings/v1`` unchanged;
                        ``perf.phase_timer`` re-exports from here)
   :mod:`.export`       Chrome-trace/Perfetto ``trace.json`` rendering
+                       (thread-keyed tracks + request flow events)
 
-CLI: ``python -m perf.trace {run,summary,export}``.  Regression gate over
-the bench trajectory: ``tools/bench_diff.py`` (wired into
+Fleet request telemetry (ISSUE 20) adds three serving-tier modules:
+
+  :mod:`.lifecycle`    per-request ``RequestTrace`` -> the
+                       ``serve_timeline/v1`` sub-doc every
+                       ``serve_result``/``serve_reject`` carries
+  :mod:`.slo`          windowed per-(tenant, grid, bucket) SLO burn
+                       rates -> ``serve_slo/v1``
+  :mod:`.flight`       fault-triggered flight recorder ->
+                       ``flight_record/v1``
+
+CLI: ``python -m perf.trace {run,summary,export,serve}``.  Regression
+gate over the bench trajectory: ``tools/bench_diff.py`` (wired into
 ``tools/check.sh``).
 """
-from .metrics import (SCHEMA as METRICS_SCHEMA, MetricsRegistry, REGISTRY,
+from .metrics import (SCHEMA as METRICS_SCHEMA, FAMILIES as HIST_FAMILIES,
+                      MetricsRegistry, REGISTRY,
                       current as current_metrics, scoped as metrics_scope,
-                      inc, observe, set_gauge)
+                      hist_family, inc, observe, set_gauge,
+                      set_hist_family)
 from .tracer import (TRACE_SCHEMA, CommEvent, InstantEvent, NullHook,
                      NULL_HOOK, PhaseRecord, Span, Tracer, active_tracer,
                      phase_hook, ring_bytes)
 from .phase_timer import PHASES, SCHEMA as PHASE_TIMINGS_SCHEMA, PhaseTimer
 from .export import (CHROME_SCHEMA, chrome_trace_doc,
                      phase_timings_to_chrome, write_json)
+from .lifecycle import (SCHEMA as TIMELINE_SCHEMA, EDGES as LIFECYCLE_EDGES,
+                        RequestTrace, check_timeline)
+from .slo import (SCHEMA as SLO_SCHEMA, SLOMonitor, SLOTarget)
+from .flight import (SCHEMA as FLIGHT_SCHEMA, FlightRecorder)
 
 __all__ = [
-    "METRICS_SCHEMA", "MetricsRegistry", "REGISTRY", "current_metrics",
-    "metrics_scope", "inc", "observe", "set_gauge",
+    "METRICS_SCHEMA", "HIST_FAMILIES", "MetricsRegistry", "REGISTRY",
+    "current_metrics", "metrics_scope", "hist_family", "inc", "observe",
+    "set_gauge", "set_hist_family",
     "TRACE_SCHEMA", "CommEvent", "InstantEvent", "NullHook", "NULL_HOOK",
     "PhaseRecord", "Span", "Tracer", "active_tracer", "phase_hook",
     "ring_bytes",
     "PHASES", "PHASE_TIMINGS_SCHEMA", "PhaseTimer",
     "CHROME_SCHEMA", "chrome_trace_doc", "phase_timings_to_chrome",
     "write_json",
+    "TIMELINE_SCHEMA", "LIFECYCLE_EDGES", "RequestTrace", "check_timeline",
+    "SLO_SCHEMA", "SLOMonitor", "SLOTarget",
+    "FLIGHT_SCHEMA", "FlightRecorder",
 ]

@@ -38,11 +38,48 @@ from __future__ import annotations
 
 import contextlib
 import json
+import threading
 
 SCHEMA = "obs_metrics/v1"
 
 #: histogram bucket upper bounds, seconds (log ladder; +Inf is implicit)
 BUCKETS = (1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0, 10.0, 100.0)
+
+#: per-family ladders (ISSUE 20 satellite): byte-valued observations
+#: (``*_bytes``) and count-valued ones (``*_count``) get ladders in
+#: their own units instead of landing in the seconds ladder's top bucket
+BYTE_BUCKETS = (256, 4096, 65536, 1 << 20, 16 << 20, 256 << 20,
+                4 << 30, 64 << 30)
+COUNT_BUCKETS = (1, 2, 5, 10, 20, 50, 100, 1000, 10000)
+
+FAMILIES = {"seconds": BUCKETS, "bytes": BYTE_BUCKETS,
+            "count": COUNT_BUCKETS}
+
+#: explicit metric-name -> family registrations (suffix rules otherwise)
+_FAMILY_OVERRIDES: dict = {}
+
+
+def set_hist_family(name: str, family: str) -> None:
+    """Pin metric ``name``'s histogram ladder to ``family`` (one of
+    :data:`FAMILIES`); overrides the suffix-based default."""
+    if family not in FAMILIES:
+        raise ValueError(f"unknown family {family!r}; "
+                         f"expected one of {sorted(FAMILIES)}")
+    _FAMILY_OVERRIDES[name] = family
+
+
+def hist_family(name: str) -> str:
+    """Resolve a metric name's bucket family: explicit registration
+    first, then suffix convention (``*_bytes`` -> bytes, ``*_count`` /
+    ``*_calls`` -> count), else seconds."""
+    fam = _FAMILY_OVERRIDES.get(name)
+    if fam is not None:
+        return fam
+    if name.endswith("_bytes"):
+        return "bytes"
+    if name.endswith(("_count", "_calls")):
+        return "count"
+    return "seconds"
 
 
 def _label_key(labels: dict) -> tuple:
@@ -55,45 +92,64 @@ def _coerce(v):
 
 
 class MetricsRegistry:
-    """One in-process sink for counters/gauges/histograms."""
+    """One in-process sink for counters/gauges/histograms.
+
+    Thread-safe (ISSUE 20 satellite): fleet GridWorker threads write
+    concurrently with the submitting thread, so every read-modify-write
+    -- the counter add, the lazy histogram init, the bucket bump --
+    happens under one registry lock.  Reads snapshot under the same
+    lock, so ``to_doc`` never sees a half-updated histogram.
+    """
 
     def __init__(self):
         self._counters: dict = {}
         self._gauges: dict = {}
-        self._hists: dict = {}      # key -> [count, sum, min, max, [bucket counts]]
+        # key -> [count, sum, min, max, [bucket counts], ladder, family]
+        self._hists: dict = {}
+        self._lock = threading.Lock()
 
     # ---- writes ------------------------------------------------------
     def inc(self, name: str, value: float = 1, **labels) -> None:
         key = (name, _label_key(labels))
-        self._counters[key] = self._counters.get(key, 0) + value
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + value
 
     def set_gauge(self, name: str, value: float, **labels) -> None:
-        self._gauges[(name, _label_key(labels))] = value
+        with self._lock:
+            self._gauges[(name, _label_key(labels))] = value
 
-    def observe(self, name: str, value: float, **labels) -> None:
+    def observe(self, name: str, value: float, family: str | None = None,
+                **labels) -> None:
         key = (name, _label_key(labels))
-        h = self._hists.get(key)
-        if h is None:
-            h = self._hists[key] = [0, 0.0, None, None, [0] * (len(BUCKETS) + 1)]
-        h[0] += 1
-        h[1] += value
-        h[2] = value if h[2] is None else min(h[2], value)
-        h[3] = value if h[3] is None else max(h[3], value)
-        for i, le in enumerate(BUCKETS):
-            if value <= le:
-                h[4][i] += 1
-                break
-        else:
-            h[4][-1] += 1
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                fam = family if family is not None else hist_family(name)
+                ladder = FAMILIES.get(fam, BUCKETS)
+                h = self._hists[key] = [0, 0.0, None, None,
+                                        [0] * (len(ladder) + 1), ladder,
+                                        fam]
+            h[0] += 1
+            h[1] += value
+            h[2] = value if h[2] is None else min(h[2], value)
+            h[3] = value if h[3] is None else max(h[3], value)
+            for i, le in enumerate(h[5]):
+                if value <= le:
+                    h[4][i] += 1
+                    break
+            else:
+                h[4][-1] += 1
 
     # ---- reads -------------------------------------------------------
     def counter_value(self, name: str, **labels) -> float:
-        return self._counters.get((name, _label_key(labels)), 0)
+        with self._lock:
+            return self._counters.get((name, _label_key(labels)), 0)
 
     def counters(self, name: str | None = None) -> dict:
         """{(name, labels-tuple): value}, optionally filtered by name."""
-        return {k: v for k, v in self._counters.items()
-                if name is None or k[0] == name}
+        with self._lock:
+            return {k: v for k, v in self._counters.items()
+                    if name is None or k[0] == name}
 
     def to_doc(self, **meta) -> dict:
         """The stable ``obs_metrics/v1`` document (meta merges at top level)."""
@@ -105,10 +161,16 @@ class MetricsRegistry:
                             "value": v})
             return out
 
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hist_snap = [(k, [h[0], h[1], h[2], h[3], list(h[4]), h[5],
+                              h[6]])
+                         for k, h in self._hists.items()]
         hists = []
-        for (name, lk), h in sorted(self._hists.items(), key=lambda kv: repr(kv[0])):
+        for (name, lk), h in sorted(hist_snap, key=lambda kv: repr(kv[0])):
             cum, buckets = 0, []
-            for le, cnt in zip(BUCKETS, h[4]):
+            for le, cnt in zip(h[5], h[4]):
                 cum += cnt
                 buckets.append({"le": le, "count": cum})
             buckets.append({"le": "+Inf", "count": cum + h[4][-1]})
@@ -117,9 +179,10 @@ class MetricsRegistry:
                           "count": h[0], "sum": h[1],
                           "min": h[2], "max": h[3],
                           "mean": (h[1] / h[0]) if h[0] else None,
+                          "family": h[6],
                           "buckets": buckets})
-        doc = {"schema": SCHEMA, "counters": rows(self._counters),
-               "gauges": rows(self._gauges), "histograms": hists}
+        doc = {"schema": SCHEMA, "counters": rows(counters),
+               "gauges": rows(gauges), "histograms": hists}
         doc.update(meta)
         return doc
 
@@ -158,5 +221,6 @@ def set_gauge(name: str, value: float, **labels) -> None:
     _CURRENT.set_gauge(name, value, **labels)
 
 
-def observe(name: str, value: float, **labels) -> None:
-    _CURRENT.observe(name, value, **labels)
+def observe(name: str, value: float, family: str | None = None,
+            **labels) -> None:
+    _CURRENT.observe(name, value, family=family, **labels)

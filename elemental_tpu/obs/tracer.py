@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import threading
 import time
 
 import jax
@@ -60,6 +61,10 @@ class Span:
     t1: float | None
     depth: int
     attrs: dict
+    #: originating thread (0 = unattributed/legacy); the exporter keys
+    #: Chrome-trace tracks by this so fleet worker spans don't collide
+    thread: int = 0
+    thread_name: str = ""
 
 
 @dataclasses.dataclass
@@ -71,6 +76,8 @@ class PhaseRecord:
     t0: float
     t1: float
     call: int                    # driver-invocation ordinal (channel id)
+    thread: int = 0
+    thread_name: str = ""
 
     @property
     def seconds(self) -> float:
@@ -83,6 +90,8 @@ class InstantEvent:
     t: float
     name: str
     attrs: dict
+    thread: int = 0
+    thread_name: str = ""
 
 
 @dataclasses.dataclass
@@ -110,6 +119,8 @@ class CommEvent:
     #: wire dtype (-1 = not computed) -- finer than the coarse ``bytes``/
     #: ``wire_bytes`` estimate, and the per-round byte record of the path
     engine_wire_bytes: int = -1
+    thread: int = 0
+    thread_name: str = ""
 
 
 def ring_bytes(gshape, dtype, grid_shape) -> int:
@@ -201,7 +212,15 @@ def active_tracer() -> "Tracer | None":
 
 
 class Tracer:
-    """Collects spans, driver phase records, and collective events."""
+    """Collects spans, driver phase records, and collective events.
+
+    Thread-safe (ISSUE 20 satellite): fleet GridWorker threads record
+    spans/phases/instants concurrently with the submitting thread.  The
+    shared record lists append under one lock; span NESTING state (the
+    open-span stack and the most-recent-driver attribution) is
+    thread-local, so each thread nests independently and the exporter
+    can key tracks by the recorded originating thread.
+    """
 
     def __init__(self, metrics: bool = True, clock=time.perf_counter):
         self.clock = clock
@@ -209,39 +228,68 @@ class Tracer:
         self.phases: list[PhaseRecord] = []
         self.comms: list[CommEvent] = []
         self.instants: list[InstantEvent] = []
-        self._stack: list[Span] = []
+        self.home_thread = threading.get_ident()
+        self._lock = threading.Lock()
+        self._tls = threading.local()
         self._metrics = metrics
         self._ncalls = 0
-        self._cur_driver: str | None = None
         self._prev_active: Tracer | None = None
         self._unobserve = None
+
+    def _thread_stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    @property
+    def _cur_driver(self):
+        return getattr(self._tls, "driver", None)
+
+    @_cur_driver.setter
+    def _cur_driver(self, driver):
+        self._tls.driver = driver
+
+    @staticmethod
+    def _whoami() -> tuple:
+        return threading.get_ident(), threading.current_thread().name
 
     # ---- explicit spans ---------------------------------------------
     @contextlib.contextmanager
     def span(self, name: str, sync=None, **attrs):
         """Open a nested span; if ``sync`` is given (arrays / pytree), the
         span blocks on it before closing so the duration is honest."""
+        stack = self._thread_stack()
+        ident, tname = self._whoami()
         s = Span(name=str(name), t0=self.clock(), t1=None,
-                 depth=len(self._stack), attrs=dict(attrs))
-        self.spans.append(s)
-        self._stack.append(s)
+                 depth=len(stack), attrs=dict(attrs), thread=ident,
+                 thread_name=tname)
+        with self._lock:
+            self.spans.append(s)
+        stack.append(s)
         try:
             yield s
         finally:
             if sync is not None:
                 jax.block_until_ready(sync)
             s.t1 = self.clock()
-            self._stack.pop()
+            stack.pop()
 
     # ---- driver tick channels ---------------------------------------
     def channel(self, driver: str, **attrs) -> _TickChannel:
         """A fresh tick channel; one per driver invocation."""
-        self._ncalls += 1
+        with self._lock:
+            self._ncalls += 1
+            call = self._ncalls
         self._cur_driver = driver
-        return _TickChannel(self, driver, self._ncalls, attrs)
+        return _TickChannel(self, driver, call, attrs)
 
     def _add_phase(self, driver, phase, step, t0, t1, call):
-        self.phases.append(PhaseRecord(driver, phase, step, t0, t1, call))
+        ident, tname = self._whoami()
+        rec = PhaseRecord(driver, phase, step, t0, t1, call,
+                          thread=ident, thread_name=tname)
+        with self._lock:
+            self.phases.append(rec)
         self._cur_driver = driver
         if self._metrics:
             _metrics.observe("phase_seconds", t1 - t0, driver=driver,
@@ -252,9 +300,15 @@ class Tracer:
         """Record a zero-duration event (rendered on an ``events`` track
         by the Chrome-trace exporter).  The resilience health guards use
         this to surface ``health:<kind>`` flags inline with the phase
-        spans of the run that produced them."""
-        self.instants.append(InstantEvent(t=self.clock(), name=str(name),
-                                          attrs=dict(attrs)))
+        spans of the run that produced them; request lifecycle marks use
+        it with a ``flow=`` attr, which the exporter links into
+        Chrome-trace flow events (``ph: s/t/f``)."""
+        ident, tname = self._whoami()
+        ev = InstantEvent(t=self.clock(), name=str(name),
+                          attrs=dict(attrs), thread=ident,
+                          thread_name=tname)
+        with self._lock:
+            self.instants.append(ev)
 
     # ---- engine observer --------------------------------------------
     def _on_redist(self, rec) -> None:
@@ -263,18 +317,27 @@ class Tracer:
         wire = getattr(rec, "wire_dtype", "") or rec.dtype
         wbytes = nbytes if wire == rec.dtype \
             else ring_bytes(rec.gshape, wire, grid_shape)
-        self.comms.append(CommEvent(
+        stack = self._thread_stack()
+        ident, tname = self._whoami()
+        ev = CommEvent(
             t=self.clock(), kind=rec.kind, label=rec.label,
             gshape=tuple(rec.gshape), dtype=rec.dtype, bytes=nbytes,
-            span=self._stack[-1].name if self._stack else None,
+            span=stack[-1].name if stack else None,
             driver=self._cur_driver, wire_dtype=wire, wire_bytes=wbytes,
             path=str(getattr(rec, "path", "") or ""),
             rounds=int(getattr(rec, "rounds", -1)),
-            engine_wire_bytes=int(getattr(rec, "wire_bytes", -1))))
+            engine_wire_bytes=int(getattr(rec, "wire_bytes", -1)),
+            thread=ident, thread_name=tname)
+        with self._lock:
+            self.comms.append(ev)
         if self._metrics:
             _metrics.inc("redist_calls", label=rec.label)
             _metrics.inc("redist_bytes", nbytes, label=rec.label)
             _metrics.inc("redist_wire_bytes", wbytes, label=rec.label)
+            # byte-family histogram (per-family ladder, ISSUE 20): the
+            # wire-byte distribution per entry, not just the total
+            _metrics.observe("redist_event_bytes", wbytes,
+                             label=rec.label)
 
     # ---- activation --------------------------------------------------
     def __enter__(self) -> "Tracer":

@@ -155,6 +155,10 @@ class SolveRequest:
     deadline: Deadline | None
     submitted: float             # admission clock timestamp
     tenant: str | None = None    # fleet tenant (ISSUE 19), None = direct
+    #: lifecycle timeline (ISSUE 20): the per-request
+    #: ``obs.lifecycle.RequestTrace`` that rides the request through
+    #: stage/dispatch/collect/certify; None = untraced (old callers)
+    trace: object = None
 
     @property
     def n(self) -> int:
@@ -168,22 +172,36 @@ class SolveRequest:
 def reject_doc(reason: str, *, bucket: Bucket | None = None,
                queue_depth: int = 0, estimate_s: float | None = None,
                deadline: Deadline | None = None, detail: str = "",
-               grid: str | None = None, tenant: str | None = None) -> dict:
+               grid: str | None = None, tenant: str | None = None,
+               trace=None) -> dict:
     """A structured fast-reject (``serve_reject/v1``).
 
     ``grid`` / ``tenant`` (ISSUE 19) attribute the decision to the fleet
     member that made it and the quota bucket it was charged against;
     both default to None for the single-service path, so old documents
-    and old readers stay valid (absent == None)."""
+    and old readers stay valid (absent == None).
+
+    ``trace`` (ISSUE 20): the request's lifecycle
+    :class:`~elemental_tpu.obs.lifecycle.RequestTrace`, when one exists.
+    The reject closes it -- ``shed`` (with the reason) then the terminal
+    ``rejected`` edge -- and the doc gains the ``timeline`` sub-doc, so
+    rejected requests carry the same end-to-end record results do."""
     if reason not in REJECT_REASONS:
         raise ValueError(f"unknown reject reason {reason!r}; "
                          f"expected one of {REJECT_REASONS}")
-    return {"schema": REJECT_SCHEMA, "reason": reason,
-            "bucket": bucket.key() if bucket is not None else None,
-            "queue_depth": int(queue_depth),
-            "estimate_s": None if estimate_s is None else float(estimate_s),
-            "deadline": deadline.to_doc() if deadline is not None else None,
-            "detail": detail, "grid": grid, "tenant": tenant}
+    doc = {"schema": REJECT_SCHEMA, "reason": reason,
+           "bucket": bucket.key() if bucket is not None else None,
+           "queue_depth": int(queue_depth),
+           "estimate_s": None if estimate_s is None else float(estimate_s),
+           "deadline": deadline.to_doc() if deadline is not None else None,
+           "detail": detail, "grid": grid, "tenant": tenant,
+           "timeline": None}
+    if trace is not None:
+        trace.annotate(grid=grid, tenant=tenant, bucket=bucket)
+        trace.mark("shed", reason=reason)
+        trace.mark("rejected")
+        doc["timeline"] = trace.to_doc()
+    return doc
 
 
 def validate_problem(op: str, A, B):
@@ -321,18 +339,27 @@ class AdmissionController:
 
     # ---- admission ---------------------------------------------------
     def admit(self, op: str, A, B, deadline: Deadline | None = None,
-              queue_depth=0, tenant: str | None = None):
+              queue_depth=0, tenant: str | None = None, trace=None):
         """One admission decision: :class:`SolveRequest` or reject dict.
 
         ``queue_depth`` is the number of same-bucket requests already
         waiting -- an int, or a callable ``bucket -> int`` (the bucket is
         only known after validation, so a queue-owning caller passes its
         depth lookup).  ``tenant`` rides into the request and every
-        reject this call issues (the fleet path, ISSUE 19)."""
+        reject this call issues (the fleet path, ISSUE 19).  ``trace``
+        (ISSUE 20) is the request's lifecycle trace: admission marks the
+        ``admitted`` edge (or closes it with ``shed``/``rejected``) and
+        attaches it to the :class:`SolveRequest` so the executor can
+        mark the batch stages."""
         v = validate_problem(op, A, B)
         if isinstance(v, dict):
             v["grid"] = self.grid
             v["tenant"] = tenant
+            if trace is not None:
+                trace.annotate(grid=self.grid, tenant=tenant)
+                trace.mark("shed", reason=v["reason"])
+                trace.mark("rejected")
+                v["timeline"] = trace.to_doc()
             return v
         op, A, B, bucket = v
         if callable(queue_depth):
@@ -343,6 +370,7 @@ class AdmissionController:
             return reject_doc(
                 "memory_pressure", bucket=bucket, queue_depth=queue_depth,
                 deadline=deadline, grid=self.grid, tenant=tenant,
+                trace=trace,
                 detail=f"static peak {int(peak)} B/batch x"
                        f"{self.pipeline_depth} ("
                        + ("double buffer"
@@ -353,7 +381,7 @@ class AdmissionController:
             if deadline.expired():
                 return reject_doc("deadline_expired", bucket=bucket,
                                   queue_depth=queue_depth, deadline=deadline,
-                                  grid=self.grid, tenant=tenant)
+                                  grid=self.grid, tenant=tenant, trace=trace)
             if self.shed:
                 wait = self.estimated_wait_s(bucket, queue_depth)
                 if wait > deadline.remaining():
@@ -361,8 +389,17 @@ class AdmissionController:
                         "queue_pressure", bucket=bucket,
                         queue_depth=queue_depth, estimate_s=wait,
                         deadline=deadline, grid=self.grid, tenant=tenant,
+                        trace=trace,
                         detail=f"estimated wait {wait:.3g}s exceeds "
                                f"remaining {deadline.remaining():.3g}s")
-        return SolveRequest(id=next(self._ids), op=op, A=A, B=B,
-                            bucket=bucket, deadline=deadline,
-                            submitted=self.clock(), tenant=tenant)
+        req = SolveRequest(id=next(self._ids), op=op, A=A, B=B,
+                           bucket=bucket, deadline=deadline,
+                           submitted=self.clock(), tenant=tenant,
+                           trace=trace)
+        if trace is not None:
+            trace.annotate(id=trace.id if trace.id is not None else req.id,
+                           grid=self.grid, tenant=tenant, bucket=bucket,
+                           op=op)
+            trace.mark("admitted", grid=self.grid, bucket=bucket.key(),
+                       queue_depth=queue_depth)
+        return req

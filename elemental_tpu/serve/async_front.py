@@ -64,6 +64,7 @@ import queue
 import threading
 
 from ..obs import metrics as _metrics
+from ..obs.lifecycle import RequestTrace
 from .admission import Deadline, reject_doc
 from .service import SolverService
 
@@ -144,13 +145,14 @@ class _Submission:
     """One enqueued submit (plain struct; also the wake-up sentinel when
     ``future is None``)."""
 
-    __slots__ = ("op", "A", "B", "deadline", "future", "tenant")
+    __slots__ = ("op", "A", "B", "deadline", "future", "tenant", "trace")
 
     def __init__(self, op=None, A=None, B=None, deadline=None, future=None,
-                 tenant=None):
+                 tenant=None, trace=None):
         self.op, self.A, self.B = op, A, B
         self.deadline, self.future = deadline, future
         self.tenant = tenant
+        self.trace = trace
 
 
 class AsyncSolverService:
@@ -181,8 +183,13 @@ class AsyncSolverService:
         self._t_last = None
         self._t_ready = None             # previous batch's ready time
         self.service.on_result = self._on_result
+        # thread name carries the grid for the per-worker export tracks
+        # (ISSUE 20); leak checks match by the shared prefix
+        wname = "elemental-serve-worker"
+        if self.service.name:
+            wname += f":{self.service.name}"
         self._worker = threading.Thread(
-            target=self._run, name="elemental-serve-worker", daemon=True)
+            target=self._run, name=wname, daemon=True)
         if autostart:
             self._worker.start()
 
@@ -196,26 +203,34 @@ class AsyncSolverService:
     # ---- client side -------------------------------------------------
     def submit(self, op: str, A, B, *, budget_s: float | None = None,
                deadline: Deadline | None = None,
-               callback=None, tenant: str | None = None) -> ServeFuture:
+               callback=None, tenant: str | None = None,
+               trace: RequestTrace | None = None) -> ServeFuture:
         """Enqueue one request; returns its :class:`ServeFuture`.
 
         Rejections (load shed, expired deadline, open breaker, bad
         request, shutdown) resolve the future with the structured
         ``serve_reject/v1`` -- nothing raises.  The deadline clock
-        starts HERE (submit time), not at worker ingest."""
+        starts HERE (submit time), not at worker ingest -- and so does
+        the lifecycle timeline: ``submitted`` is stamped on the CALLER's
+        thread (a fleet passes its own ``trace``, already stamped)."""
         fut = ServeFuture()
         if callback is not None:
             fut.add_done_callback(callback)
         if deadline is None and budget_s is not None:
             deadline = Deadline(budget_s, clock=self.service.clock)
+        if trace is None:
+            trace = RequestTrace(clock=self.service.clock, tenant=tenant,
+                                 op=op, flight=self.service.flight)
+            trace.mark("submitted", op=op)
         if self._stop:
             _metrics.inc("serve_rejects", reason="shutdown")
             fut._resolve(reject_doc("shutdown", deadline=deadline,
                                     grid=self.service.name, tenant=tenant,
-                                    detail="async service has shut down"),
+                                    detail="async service has shut down",
+                                    trace=trace),
                          None)
             return fut
-        self._qin.put(_Submission(op, A, B, deadline, fut, tenant))
+        self._qin.put(_Submission(op, A, B, deadline, fut, tenant, trace))
         _metrics.set_gauge("serve_async_submit_queue", self._qin.qsize())
         return fut
 
@@ -286,7 +301,7 @@ class AsyncSolverService:
                 self._flush_submission(sub)
                 continue
             out = svc.submit(sub.op, sub.A, sub.B, deadline=sub.deadline,
-                             tenant=sub.tenant)
+                             tenant=sub.tenant, trace=sub.trace)
             if isinstance(out, dict):    # structured fast reject
                 sub.future._resolve(out, None)
             else:
@@ -300,7 +315,8 @@ class AsyncSolverService:
         sub.future._resolve(
             reject_doc("shutdown", deadline=sub.deadline,
                        grid=self.service.name, tenant=sub.tenant,
-                       detail="flushed by shutdown(drain=False)"), None)
+                       detail="flushed by shutdown(drain=False)",
+                       trace=sub.trace), None)
 
     def _stage_next(self):
         """Pop + prepare + stage + DISPATCH the next batch (returns the

@@ -61,6 +61,8 @@ zero silent drops and bit-identical replay.
 """
 from __future__ import annotations
 
+import json
+
 import numpy as np
 
 from ..resilience.faults import (FAULT_KINDS, FaultPlan, FaultSpec,
@@ -741,6 +743,10 @@ def run_fleet_grid_loss_cell(*, n: int = 16, nrhs: int = 2,
         violations.append({"kind": "vacuous",
                            "detail": "poisoned member's breaker never "
                                      "opened -- no grid loss happened"})
+    elif fleet.flight.last_dump() is None:
+        violations.append({"kind": "unstructured",
+                           "detail": "breaker opened but the flight "
+                                     "recorder never dumped"})
     workload_b = build_workload("hpd", n, nrhs, requests, seed + 1)
     futs_b = [fleet.submit("hpd", A, B, tenant="b") for A, B in workload_b]
     fleet.drain()
@@ -768,6 +774,11 @@ def run_fleet_grid_loss_cell(*, n: int = 16, nrhs: int = 2,
             "op": "hpd", "column": "fleet", "grids": 2,
             "requests": 2 * requests, "ok": ok_a + ok_b, "fired": g0_served,
             "budget_s": None, "outcomes": outcomes,
+            # the breaker-open dump (ISSUE 20): the flight recorder's
+            # retrospective of everything the fleet did before the trip;
+            # deterministic under the virtual clock, so replays compare
+            # it bit-for-bit
+            "flight": fleet.flight.last_dump(),
             "verdict": "isolated" if not violations else "surfaced",
             "violations": violations}, fleet
 
@@ -782,7 +793,12 @@ def fleet_replay_identical(*, n: int = 16, requests: int = 8,
     c2, _ = run_fleet_grid_loss_cell(n=n, requests=requests, seed=seed)
     same = [c1["outcomes"][k] for k in sorted(c1["outcomes"])] \
         == [c2["outcomes"][k] for k in sorted(c2["outcomes"])]
-    return same and c1["verdict"] == c2["verdict"] \
+    # the breaker-open flight dump must replay BIT-IDENTICALLY (ISSUE
+    # 20): the recorder touches only the injected clock and lock-ordered
+    # sequence numbers, so the serialized dumps compare byte-for-byte
+    same_flight = json.dumps(c1.get("flight"), sort_keys=True) \
+        == json.dumps(c2.get("flight"), sort_keys=True)
+    return same and same_flight and c1["verdict"] == c2["verdict"] \
         and c1["ok"] == c2["ok"]
 
 

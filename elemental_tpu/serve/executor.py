@@ -376,6 +376,14 @@ class Executor:
         self.device = device
         self.tune_ns = str(tune_ns)
 
+    @staticmethod
+    def _mark(staged: "Staged", edge: str, **attrs) -> None:
+        """Lifecycle edge on every request of the batch (ISSUE 20)."""
+        for req in staged.requests:
+            tr = getattr(req, "trace", None)
+            if tr is not None:
+                tr.mark(edge, batch=len(staged.requests), **attrs)
+
     def stage(self, bucket: Bucket, requests, *, donate: bool = False):
         """HOST stage: pad + stack every request, look up the executable.
 
@@ -412,6 +420,7 @@ class Executor:
                         compiled=compiled, a=da, b=db, donate=donate)
         _metrics.observe("serve_stage_seconds", self.clock() - t0,
                          op=bucket.op, stage="stage")
+        self._mark(staged, "staged", slots=slots)
         return staged
 
     def dispatch(self, staged: Staged) -> Staged:
@@ -424,6 +433,7 @@ class Executor:
             staged.a = staged.b = None       # donated: buffers are dead
         _metrics.observe("serve_stage_seconds", self.clock() - t0,
                          op=staged.bucket.op, stage="dispatch")
+        self._mark(staged, "dispatched")
         return staged
 
     def collect(self, staged: Staged):
@@ -453,6 +463,7 @@ class Executor:
         _metrics.inc("serve_batched_solves", len(requests), op=bucket.op)
         _metrics.observe("serve_stage_seconds", self.clock() - t1,
                          op=bucket.op, stage="collect")
+        self._mark(staged, "collected", seconds=seconds)
         return xs, seconds
 
     def run(self, bucket: Bucket, requests, *, donate: bool = False):

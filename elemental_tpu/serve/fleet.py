@@ -74,6 +74,9 @@ import numpy as np
 
 from ..core.grid import Grid
 from ..obs import metrics as _metrics
+from ..obs.flight import FlightRecorder
+from ..obs.lifecycle import RequestTrace
+from ..obs.slo import SLOMonitor
 from ..obs.tracer import phase_hook
 from .admission import Deadline, reject_doc, validate_problem
 from .async_front import AsyncSolverService, ServeFuture
@@ -153,11 +156,12 @@ class _FleetSub:
     """One scheduled submission (held in the FairScheduler until a
     member has capacity)."""
 
-    __slots__ = ("op", "A", "B", "bucket", "deadline", "future")
+    __slots__ = ("op", "A", "B", "bucket", "deadline", "future", "trace")
 
-    def __init__(self, op, A, B, bucket, deadline, future):
+    def __init__(self, op, A, B, bucket, deadline, future, trace=None):
         self.op, self.A, self.B = op, A, B
         self.bucket, self.deadline, self.future = bucket, deadline, future
+        self.trace = trace
 
 
 class GridWorker(AsyncSolverService):
@@ -190,11 +194,18 @@ class SolverFleet:
     def __init__(self, devices=None, *, grids=2, depth: int = 3,
                  quotas: dict | None = None, pipelined: bool = True,
                  autostart: bool = True, clock=time.monotonic,
-                 sleep=None, **core_kw):
+                 sleep=None, flight=None, slo=None, **core_kw):
         parts = partition_devices(devices, grids)
         self.pipelined = bool(pipelined)
         self.depth = max(int(depth), 1)
         self.clock = clock
+        #: ONE flight recorder shared by every member (ISSUE 20): a
+        #: breaker trip on g1 dumps the record of what g0 was doing too
+        self.flight = flight if flight is not None \
+            else FlightRecorder(clock=clock)
+        #: windowed per-(tenant, grid, bucket) SLO estimators, fed by
+        #: every settled doc
+        self.slo = slo if slo is not None else SLOMonitor()
         self.scheduler = FairScheduler(quotas=quotas)
         self.services: list = []         # per-member SolverService cores
         self.workers: list = []          # pipelined mode: GridWorker per core
@@ -203,7 +214,7 @@ class SolverFleet:
             svc = SolverService(
                 Grid(list(devs)), name=name, tune_ns=name,
                 pipeline_depth=self.depth, device=devs[0],
-                clock=clock, sleep=sleep, **core_kw)
+                clock=clock, sleep=sleep, flight=self.flight, **core_kw)
             self.services.append(svc)
             if self.pipelined:
                 self.workers.append(GridWorker(
@@ -253,6 +264,8 @@ class SolverFleet:
         with self._lock:
             self.results[fut.fleet_id] = doc
             self._settled.append((fut.fleet_id, doc))
+            if isinstance(doc, dict):    # windowed SLO feed (ISSUE 20)
+                self.slo.record(doc)
         fut._resolve(doc, x)
 
     def _account(self, fut) -> None:
@@ -276,20 +289,30 @@ class SolverFleet:
         tenant = DEFAULT_TENANT if tenant is None else str(tenant)
         fut = FleetFuture(next(self._ids), tenant)
         fut.t0 = self.clock()
+        # fleet-global id keys the lifecycle flow: member request ids
+        # collide across grids, fleet ids never do
+        trace = RequestTrace(id=f"f{fut.fleet_id}", clock=self.clock,
+                             tenant=tenant, op=op, flight=self.flight)
+        trace.mark("submitted", op=op)
         if callback is not None:
             fut.add_done_callback(callback)
         if deadline is None and budget_s is not None:
             deadline = Deadline(budget_s, clock=self.clock)
         if self._stop:
             _metrics.inc("serve_rejects", reason="shutdown")
+            self.flight.record("reject", reason="shutdown", tenant=tenant)
             self._settle(fut, reject_doc(
                 "shutdown", deadline=deadline, tenant=tenant,
-                detail="fleet has shut down"), None)
+                detail="fleet has shut down", trace=trace), None)
             return fut
         v = validate_problem(op, A, B)
         if isinstance(v, dict):
             v["tenant"] = tenant
             _metrics.inc("serve_rejects", reason=v["reason"])
+            self.flight.record("reject", reason=v["reason"], tenant=tenant)
+            trace.mark("shed", reason=v["reason"])
+            trace.mark("rejected")
+            v["timeline"] = trace.to_doc()
             self._settle(fut, v, None)
             return fut
         op, A, B, bucket = v
@@ -298,17 +321,20 @@ class SolverFleet:
             if q.max_outstanding is not None \
                     and self._tenant_out.get(tenant, 0) >= q.max_outstanding:
                 _metrics.inc("serve_rejects", reason="quota")
+                # kind='reject' reason='quota' is what arms the flight
+                # recorder's quota-storm trigger
+                self.flight.record("reject", reason="quota", tenant=tenant)
                 self._settle(fut, reject_doc(
                     "quota", bucket=bucket,
                     queue_depth=self.scheduler.pending(tenant),
                     deadline=deadline, tenant=tenant,
                     detail=f"tenant {tenant!r} at max_outstanding="
-                           f"{q.max_outstanding}"), None)
+                           f"{q.max_outstanding}", trace=trace), None)
                 return fut
             self._tenant_out[tenant] = self._tenant_out.get(tenant, 0) + 1
             fut.add_done_callback(self._account)
             self.scheduler.push(
-                tenant, _FleetSub(op, A, B, bucket, deadline, fut),
+                tenant, _FleetSub(op, A, B, bucket, deadline, fut, trace),
                 cost=bucket.solve_flops())
         self._pump()
         return fut
@@ -369,12 +395,16 @@ class SolverFleet:
             else "breaker_open"
         gi, why = blocked[0]
         _metrics.inc("serve_rejects", reason=reason)
+        self.flight.record("reject", reason=reason,
+                           tenant=sub.future.tenant,
+                           bucket=sub.bucket.key())
         return reject_doc(
             reason, bucket=sub.bucket, deadline=sub.deadline,
             grid=self.services[gi].name, tenant=sub.future.tenant,
             detail=f"no fleet member can take {sub.bucket.key()}: "
                    + ", ".join(f"{self.services[g].name}={w}"
-                               for g, w in blocked))
+                               for g, w in blocked),
+            trace=sub.trace)
 
     def _pump(self) -> int:
         """Release scheduled work into member capacity, fairest first.
@@ -407,6 +437,8 @@ class SolverFleet:
         """Hand one submission to member ``gi`` (lock held)."""
         svc = self.services[gi]
         sub.future.grid = svc.name
+        if sub.trace is not None:
+            sub.trace.annotate(grid=svc.name)
         self._grid_out[gi] += 1
         if self.pipelined:
             fut = sub.future
@@ -418,10 +450,10 @@ class SolverFleet:
 
             self.workers[gi].submit(
                 sub.op, sub.A, sub.B, deadline=sub.deadline,
-                tenant=fut.tenant, callback=chain)
+                tenant=fut.tenant, callback=chain, trace=sub.trace)
             return
         out = svc.submit(sub.op, sub.A, sub.B, deadline=sub.deadline,
-                         tenant=sub.future.tenant)
+                         tenant=sub.future.tenant, trace=sub.trace)
         if isinstance(out, dict):        # member-level fast reject
             self._grid_out[gi] = max(self._grid_out[gi] - 1, 0)
             self._settle(sub.future, out, None)
@@ -491,10 +523,13 @@ class SolverFleet:
                 held = self.scheduler.flush()
             for sub in held:
                 _metrics.inc("serve_rejects", reason="shutdown")
+                self.flight.record("reject", reason="shutdown",
+                                   tenant=sub.future.tenant)
                 self._settle(sub.future, reject_doc(
                     "shutdown", bucket=sub.bucket, deadline=sub.deadline,
                     tenant=sub.future.tenant,
-                    detail="flushed by fleet shutdown(drain=False)"), None)
+                    detail="flushed by fleet shutdown(drain=False)",
+                    trace=sub.trace), None)
             if self.pipelined:
                 for w in self.workers:
                     w.shutdown(drain=False)

@@ -85,7 +85,7 @@ class CircuitBreaker:
 
     def __init__(self, bucket_key: str, *, threshold: int = 3,
                  cooldown_s: float = 1.0, clock=time.monotonic,
-                 grid: str | None = None):
+                 grid: str | None = None, flight=None):
         self.bucket_key = str(bucket_key)
         self.threshold = max(int(threshold), 1)
         self.cooldown_s = float(cooldown_s)
@@ -94,6 +94,10 @@ class CircuitBreaker:
         #: series per grid so one pool member tripping is attributable;
         #: None (direct single-service) keeps the PR-9 label set
         self.grid = grid
+        #: flight recorder (ISSUE 20): every transition is a structured
+        #: event; tripping OPEN is a DUMP TRIGGER -- the retrospective
+        #: record of the requests that burned the breaker down
+        self.flight = flight
         self.state = CLOSED
         self.failures = 0            # consecutive certification failures
         self.opened_at: float | None = None
@@ -112,10 +116,18 @@ class CircuitBreaker:
     def _transition(self, state: str) -> None:
         if state == self.state:
             return
-        self.state = state
+        prev, self.state = self.state, state
         _metrics.inc("serve_breaker_transitions", to=state,
                      **self._labels())
         self._gauge()
+        if self.flight is not None:
+            self.flight.record("breaker", bucket=self.bucket_key,
+                               grid=self.grid, frm=prev, to=state,
+                               failures=self.failures)
+            if state == OPEN:
+                self.flight.trigger("breaker_open",
+                                    bucket=self.bucket_key, grid=self.grid,
+                                    failures=self.failures)
 
     def allow(self) -> bool:
         """May the fast path run?  Closed: yes.  Open: no, unless the

@@ -97,6 +97,9 @@ class FairScheduler:
             self._deficit[tenant] = 0.0
             self._order.append(tenant)
         q.append((item, max(float(cost), 1e-30)))
+        tr = getattr(item, "trace", None)
+        if tr is not None:                       # lifecycle (ISSUE 20)
+            tr.mark("tenant_queued", tenant=tenant, depth=len(q))
         _metrics.inc("serve_tenant_enqueued", tenant=tenant)
         _metrics.set_gauge("serve_tenant_queue_depth", len(q),
                            tenant=tenant)
@@ -178,6 +181,12 @@ class FairScheduler:
             if not q:
                 self._deficit[tenant] = 0.0      # empty queue: no credit
                 self._advance()                  # give up the turn
+            tr = getattr(item, "trace", None)
+            if tr is not None:                   # queue-wait (ISSUE 20)
+                t_q = tr.edge_t("tenant_queued")
+                if t_q is not None:
+                    _metrics.observe("serve_queue_wait_seconds",
+                                     tr.clock() - t_q, tenant=tenant)
             _metrics.set_gauge("serve_tenant_queue_depth", len(q),
                                tenant=tenant)
             _metrics.set_gauge("serve_tenant_deficit",

@@ -35,6 +35,7 @@ import time
 import numpy as np
 
 from ..obs import metrics as _metrics
+from ..obs.lifecycle import RequestTrace
 from ..obs.tracer import active_tracer, phase_hook
 from ..resilience.certify import certified_solve, default_tol
 from .admission import AdmissionController, Bucket, Deadline, reject_doc
@@ -64,7 +65,7 @@ class SolverService:
                  pipeline_depth: int = 2,
                  name: str | None = None, tune_ns: str = "",
                  device=None,
-                 clock=time.monotonic, sleep=None):
+                 clock=time.monotonic, sleep=None, flight=None):
         self.grid = grid
         self.max_batch = max(int(max_batch), 1)
         self.capacity = max(int(capacity), 1)
@@ -82,6 +83,10 @@ class SolverService:
         #: PR-9 semantics (unlabeled gauges, ``grid: None`` in docs).
         self.name = name
         self.tune_ns = str(tune_ns)
+        #: flight recorder (ISSUE 20): shared ring the breakers,
+        #: lifecycle traces and reject paths all feed; a fleet passes
+        #: ONE recorder to every member, None = not recording
+        self.flight = flight
         self.clock = clock
         self._sleep = sleep if sleep is not None else time.sleep
         kw = {} if flops_per_s is None else {"flops_per_s": flops_per_s}
@@ -119,7 +124,7 @@ class SolverService:
             br = self.breakers[bucket.key()] = CircuitBreaker(
                 bucket.key(), threshold=self.breaker_threshold,
                 cooldown_s=self.breaker_cooldown_s, clock=self.clock,
-                grid=self.name)
+                grid=self.name, flight=self.flight)
         return br
 
     def queue_depth(self, bucket: Bucket | None = None) -> int:
@@ -159,25 +164,34 @@ class SolverService:
     # ---- submit ------------------------------------------------------
     def submit(self, op: str, A, B, *, budget_s: float | None = None,
                deadline: Deadline | None = None,
-               tenant: str | None = None):
+               tenant: str | None = None, trace=None):
         """Admit one request.  Returns the request id (int) on accept or
         a structured ``serve_reject/v1`` dict on fast reject (load shed,
         expired deadline, open breaker, malformed request).  ``tenant``
         rides into the result/reject documents (the fleet path, ISSUE
-        19; quota enforcement itself lives in the fleet scheduler)."""
+        19; quota enforcement itself lives in the fleet scheduler).
+        ``trace`` (ISSUE 20) is the request's lifecycle trace -- the
+        fleet passes the one it opened at fleet submit; a direct caller
+        gets a fresh one so every outcome doc carries a ``timeline``."""
         if deadline is None and budget_s is not None:
             deadline = Deadline(budget_s, clock=self.clock)
+        if trace is None:
+            trace = RequestTrace(clock=self.clock, tenant=tenant, op=op,
+                                 flight=self.flight)
+            trace.mark("submitted", op=op)
         if self._shutdown:
             rej = reject_doc("shutdown", queue_depth=self.queue_depth(),
                              deadline=deadline, grid=self.name,
-                             tenant=tenant,
+                             tenant=tenant, trace=trace,
                              detail="service has shut down")
+            self._flight_reject("shutdown", tenant)
             _metrics.inc("serve_rejects", reason="shutdown")
             return rej
         req = self.admission.admit(op, A, B, deadline=deadline,
                                    queue_depth=self.queue_depth,
-                                   tenant=tenant)
+                                   tenant=tenant, trace=trace)
         if isinstance(req, dict):        # bad_request / expired / shed
+            self._flight_reject(req["reason"], tenant)
             _metrics.inc("serve_rejects", reason=req["reason"])
             return req
         bucket = req.bucket
@@ -191,13 +205,19 @@ class SolverService:
                 rej = reject_doc("breaker_open", bucket=bucket,
                                  queue_depth=self.queue_depth(bucket),
                                  deadline=deadline, grid=self.name,
-                                 tenant=tenant,
+                                 tenant=tenant, trace=trace,
                                  detail=f"breaker open for {bucket.key()}")
+                self._flight_reject("breaker_open", tenant)
                 _metrics.inc("serve_rejects", reason="breaker_open")
                 return rej
         self._queues.setdefault(bucket, []).append(req)
         self._gauges()
         return req.id
+
+    def _flight_reject(self, reason: str, tenant) -> None:
+        if self.flight is not None:
+            self.flight.record("reject", reason=reason, grid=self.name,
+                               tenant=tenant)
 
     def _pop_batch(self):
         """FIFO batch pop: the bucket whose HEAD request is oldest
@@ -257,10 +277,12 @@ class SolverService:
                 rej = reject_doc("shutdown", bucket=bucket,
                                  queue_depth=0, deadline=req.deadline,
                                  grid=self.name, tenant=req.tenant,
+                                 trace=req.trace,
                                  detail="flushed by shutdown(drain=False)")
                 rej["id"] = req.id
                 self.results[req.id] = rej
                 done[req.id] = rej
+                self._flight_reject("shutdown", req.tenant)
                 _metrics.inc("serve_rejects", reason="shutdown")
                 if self.on_result is not None:
                     # flushed requests are completions too: a front
@@ -351,7 +373,11 @@ class SolverService:
         passed, failed = [], []
         for req, X in zip(reqs, xs):
             res = meas(req.A, req.B, X)
-            if res <= self._tol(req):
+            ok = res <= self._tol(req)
+            if req.trace is not None:
+                req.trace.mark("certified", ok=bool(ok),
+                               residual=float(res))
+            if ok:
                 self._finalize(req, bucket, status="ok", path=path,
                                rung="fastpath", residual=res, x=X)
                 passed.append(req)
@@ -390,8 +416,11 @@ class SolverService:
     # ---- escalation --------------------------------------------------
     def _escalate(self, bucket: Bucket, req, bisected: bool = False,
                   path: str = "escalated") -> None:
+        if req.trace is not None:
+            req.trace.mark("escalated", path=path, bisected=bool(bisected))
         tr = active_tracer()
-        span = tr.span(f"serve:req:{req.id}", op=req.op) \
+        span = tr.span(f"serve:req:{req.id}", op=req.op, grid=self.name,
+                       tenant=req.tenant) \
             if tr is not None else _null_cm()
         with span:
             self._escalate_inner(bucket, req, bisected, path)
@@ -427,6 +456,10 @@ class SolverService:
             # silently mutate under a later solve
             X = None if Xd is None else np.array(
                 _to_host(Xd), dtype=np.float64)
+            if req.trace is not None:
+                req.trace.mark("certified", ok=bool(cert["certified"]),
+                               residual=cert["residual"],
+                               rung=str(cert["rung"]))
             _metrics.inc("serve_escalations", op=req.op,
                          rung=str(cert["rung"]))
             if cert["certified"]:
@@ -484,6 +517,9 @@ class SolverService:
             Xd = least_squares(Ad, Bd, nb=self.escalate_nb, abft=True)
             X = np.array(to_global(Xd), dtype=np.float64)  # owned copy
             res = ls_residual(req.A, req.B, X)
+            if req.trace is not None:
+                req.trace.mark("certified", ok=bool(res <= tol),
+                               residual=float(res), rung="grid_qr")
             _metrics.inc("serve_escalations", op=req.op, rung="grid_qr")
             if res <= tol:
                 self._finalize(req, bucket, status="ok", path=path,
@@ -508,6 +544,9 @@ class SolverService:
                   x=None, certificate: dict | None = None, retries: int = 0,
                   timed_out: bool = False, bisected: bool = False) -> None:
         latency = self.clock() - req.submitted
+        if req.trace is not None:
+            req.trace.annotate(grid=self.name, bucket=bucket, op=req.op)
+            req.trace.mark("done", status=status, path=path)
         doc = {"schema": RESULT_SCHEMA, "id": req.id, "op": req.op,
                "n": req.n, "nrhs": req.nrhs, "bucket": bucket.key(),
                "status": status, "path": path, "rung": rung,
@@ -519,8 +558,16 @@ class SolverService:
                "certificate": certificate,
                "breaker": self.breaker(bucket).state,
                "dispatch": self._dispatch.pop(req.id, None),
-               "grid": self.name, "tenant": req.tenant}
+               "grid": self.name, "tenant": req.tenant,
+               "timeline": req.trace.to_doc()
+               if req.trace is not None else None}
         self.results[req.id] = doc
+        if self.flight is not None and status == "failed":
+            # an unrecovered request -- escalation + bisection exhausted
+            # -- is a flight-recorder dump trigger (ISSUE 20)
+            self.flight.trigger("unrecovered", id=req.id, op=req.op,
+                                bucket=bucket.key(), grid=self.name,
+                                tenant=req.tenant)
         x_out = x if status == "ok" else None
         if x_out is not None:
             self.solutions[req.id] = x_out

@@ -190,7 +190,7 @@ def cmd_smoke() -> int:
     outs = [f.result(timeout=300.0) for f in futs]
     ok_async = sum(d["status"] == "ok" for _, d in outs)
     front.shutdown(drain=True)
-    leak = any(t.name == "elemental-serve-worker" and t.is_alive()
+    leak = any(t.name.startswith("elemental-serve-worker") and t.is_alive()
                for t in threading.enumerate())
     occ = front.pipeline_stats()["occupancy"]
     print(f"# smoke async: ok={ok_async}/8 streamed={len(streamed)} "
@@ -233,7 +233,7 @@ def cmd_fleet_smoke(seed) -> int:
     ok = sum(d["status"] == "ok" for _, d in outs)
     grids_used = {d["grid"] for _, d in outs}
     tenants = {d["tenant"] for _, d in outs}
-    leak = any(t.name == "elemental-serve-worker" and t.is_alive()
+    leak = any(t.name.startswith("elemental-serve-worker") and t.is_alive()
                for t in threading.enumerate())
     print(f"# fleet smoke pipelined: ok={ok}/16 grids={sorted(grids_used)} "
           f"tenants={sorted(tenants)} leak={leak}")

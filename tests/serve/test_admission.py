@@ -183,9 +183,11 @@ def test_reject_doc_schema_pin():
     doc = adm.reject_doc("queue_pressure", queue_depth=2, estimate_s=1.5)
     assert set(doc) == {"schema", "reason", "bucket", "queue_depth",
                         "estimate_s", "deadline", "detail",
-                        "grid", "tenant"}
+                        "grid", "tenant", "timeline"}
     # single-service rejects carry the fleet fields as None (ISSUE 19):
     # absent grid == not fleet-routed, absent tenant == direct caller
     assert doc["grid"] is None and doc["tenant"] is None
+    # no lifecycle trace attached -> timeline rides as None (ISSUE 20)
+    assert doc["timeline"] is None
     with pytest.raises(ValueError):
         adm.reject_doc("bogus_reason")

@@ -33,7 +33,7 @@ def _workload(rng, count=10):
 
 
 def _no_leak():
-    return not any(t.name == "elemental-serve-worker" and t.is_alive()
+    return not any(t.name.startswith("elemental-serve-worker") and t.is_alive()
                    for t in threading.enumerate())
 
 

@@ -21,7 +21,7 @@ def _workload(rng, count, n=12, nrhs=2):
 
 
 def _no_leak():
-    return not any(t.name == "elemental-serve-worker" and t.is_alive()
+    return not any(t.name.startswith("elemental-serve-worker") and t.is_alive()
                    for t in threading.enumerate())
 
 

@@ -50,7 +50,10 @@ def test_result_schema_pin(grid24):
                         "status", "path", "rung", "residual", "tol",
                         "retries", "bisected", "timed_out", "latency_s",
                         "deadline", "certificate", "breaker", "dispatch",
-                        "grid", "tenant"}
+                        "grid", "tenant", "timeline"}
+    # lifecycle timeline (ISSUE 20): a complete serve_timeline/v1
+    from elemental_tpu.obs.lifecycle import check_timeline
+    assert check_timeline(doc["timeline"], path=doc["path"]) == []
     # fleet provenance (ISSUE 19): None on a direct single service
     assert doc["grid"] is None and doc["tenant"] is None
     assert doc["bucket"] == "lu__b8x1__float64"

@@ -1,0 +1,162 @@
+"""ISSUE 20 end-to-end fleet telemetry: every settled document carries a
+complete, attributed timeline; the Chrome-trace export links a request's
+lifecycle across grid-worker tracks; the flight recorder dumps
+bit-identically under the chaos harness's virtual clock."""
+import json
+
+import numpy as np
+import pytest
+
+from elemental_tpu.obs import Tracer, chrome_trace_doc
+from elemental_tpu.obs.lifecycle import check_timeline
+from elemental_tpu.serve import SolverFleet
+from elemental_tpu.serve.chaos import (fleet_replay_identical,
+                                       run_fleet_grid_loss_cell)
+
+from .conftest import spd
+
+
+def _workload(rng, count, n=12, nrhs=2):
+    return [(spd(rng, n), rng.normal(size=(n, nrhs)))
+            for _ in range(count)]
+
+
+# ---------------------------------------------------------------------
+# 2-grid fleet attribution (the ISSUE-20 acceptance run)
+# ---------------------------------------------------------------------
+
+def test_pipelined_fleet_timelines_complete_and_attributed():
+    """Every result of a 2-grid pipelined fleet run carries a complete
+    monotone timeline whose grid/tenant attribution matches the routing
+    provenance, and the trace export links each request's lifecycle
+    instants into one serve:req flow chain crossing worker tracks."""
+    rng = np.random.default_rng(91)
+    tenants = ("acme", "blue")
+    tracer = Tracer(metrics=False)
+    fleet = SolverFleet(grids=2, pipelined=True, depth=2, max_batch=2,
+                        shed=False, retries=0)
+    try:
+        with tracer:
+            futs = [fleet.submit("hpd", A, B, tenant=tenants[i % 2])
+                    for i, (A, B) in enumerate(_workload(rng, 8))]
+            docs = [f.result(timeout=30)[1] for f in futs]
+    finally:
+        fleet.shutdown(drain=True)
+
+    grids_seen = set()
+    for f, doc in zip(futs, docs):
+        assert doc["status"] == "ok"
+        tl = doc["timeline"]
+        assert check_timeline(tl, path=doc.get("path"), fleet=True) \
+            == [], (doc.get("path"), tl)
+        assert tl["id"] == f"f{f.fleet_id}"
+        assert tl["tenant"] == doc["tenant"] == f.tenant
+        assert tl["grid"] == doc["grid"] == f.grid
+        grids_seen.add(tl["grid"])
+    assert grids_seen <= {"g0", "g1"} and grids_seen
+
+    evs = chrome_trace_doc(tracer, mode="serve")["traceEvents"]
+    tracks = [e["args"]["name"] for e in evs
+              if e["ph"] == "M" and e["name"] == "thread_name"]
+    workers = [t for t in tracks
+               if t.startswith("elemental-serve-worker")]
+    assert len(workers) >= 2          # one track block per grid worker
+    flow = [e for e in evs if e["ph"] in ("s", "t", "f")]
+    assert {e["ph"] for e in flow} >= {"s", "f"}
+    assert all(e["name"] == "serve:req" and e["cat"] == "lifecycle"
+               for e in flow)
+    # one linked chain per request, start to finish
+    by_id = {}
+    for e in flow:
+        by_id.setdefault(e["id"], []).append(e["ph"])
+    assert set(by_id) == {f"f{f.fleet_id}" for f in futs}
+    for phs in by_id.values():
+        assert phs[0] == "s" and phs[-1] == "f"
+
+
+def test_fleet_rejects_carry_timelines_too():
+    fleet = SolverFleet(grids=2, pipelined=False, shed=False)
+    try:
+        fut = fleet.submit("hpd", np.eye(3), np.ones((4, 1)))  # mismatch
+        _, doc = fut.result(timeout=0)
+        assert doc["reason"] == "bad_request"
+        tl = doc["timeline"]
+        assert check_timeline(tl) == []
+        edges = [r["edge"] for r in tl["edges"]]
+        assert edges[0] == "submitted" and edges[-1] == "rejected"
+        assert "shed" in edges
+    finally:
+        fleet.shutdown(drain=True)
+
+
+def test_fleet_slo_monitor_fed_by_settlement():
+    rng = np.random.default_rng(17)
+    fleet = SolverFleet(grids=2, pipelined=False, shed=False)
+    try:
+        for i, (A, B) in enumerate(_workload(rng, 4)):
+            fleet.submit("hpd", A, B, tenant=("acme", "blue")[i % 2])
+        fleet.drain()
+        sdoc = fleet.slo.snapshot(gauges=False, source="test")
+        assert sdoc["schema"] == "serve_slo/v1"
+        assert {r["tenant"] for r in sdoc["series"]} == {"acme", "blue"}
+        assert all(r["count"] >= 1 for r in sdoc["series"])
+        per = fleet.slo.per_tenant_p99_ms()
+        assert set(per) == {"acme", "blue"}
+        assert fleet.slo.worst_p99_ms() == max(per.values())
+    finally:
+        fleet.shutdown(drain=True)
+
+
+def test_fleet_quota_storm_dumps_flight_record():
+    """Hammering past a tenant quota long enough trips the quota_storm
+    trigger: the shared flight recorder auto-dumps with the reject run
+    visible in the ring."""
+    from elemental_tpu.serve import TenantQuota
+    rng = np.random.default_rng(23)
+    (A, B) = _workload(rng, 1)[0]
+    fleet = SolverFleet(grids=2, pipelined=False, shed=False,
+                        quotas={"noisy": TenantQuota(max_outstanding=1)})
+    fleet.flight.quota_storm_threshold = 4
+    try:
+        fleet.submit("hpd", A, B, tenant="noisy")      # fills the quota
+        for _ in range(4):
+            fleet.submit("hpd", A, B, tenant="noisy")  # all quota-shed
+        dump = fleet.flight.last_dump()
+        assert dump is not None
+        assert dump["schema"] == "flight_record/v1"
+        assert dump["trigger"]["reason"] == "quota_storm"
+        kinds = [e["kind"] for e in dump["events"]]
+        assert kinds.count("reject") == 4
+    finally:
+        fleet.shutdown(drain=True)
+
+
+# ---------------------------------------------------------------------
+# chaos: breaker-open flight dump, bit-identical under replay
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_grid_loss_cell_dumps_flight_on_breaker_open():
+    cell, fleet_doc = run_fleet_grid_loss_cell(requests=6, seed=13)
+    assert cell["verdict"] == "isolated" and cell["ok"]
+    dump = cell["flight"]
+    assert dump is not None and dump["schema"] == "flight_record/v1"
+    reasons = {dump["trigger"]["reason"]}
+    assert "breaker_open" in reasons
+    # the seconds before the fault are reconstructable: lifecycle edges
+    # of the poisoned requests precede the trigger in the ring
+    kinds = {e["kind"] for e in dump["events"]}
+    assert any(k.startswith("edge:") for k in kinds)
+    assert dump["trigger"]["seq"] >= len(dump["events"])
+
+
+@pytest.mark.slow
+def test_fleet_chaos_flight_replay_bit_identical():
+    """The determinism acceptance criterion: the same seeded grid-loss
+    cell replays to a byte-identical flight_record/v1 (virtual clock,
+    lock-ordered sequence numbers, no wall time anywhere)."""
+    c1, _ = run_fleet_grid_loss_cell(requests=6, seed=13)
+    c2, _ = run_fleet_grid_loss_cell(requests=6, seed=13)
+    assert json.dumps(c1["flight"], sort_keys=True) \
+        == json.dumps(c2["flight"], sort_keys=True)
+    assert fleet_replay_identical(requests=6, seed=13)

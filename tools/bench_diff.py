@@ -37,8 +37,9 @@ in :data:`LOWER_IS_BETTER` (the ``bench_serve.py`` percentiles, ISSUE 9)
 invert: best is the MINIMUM baseline and a regression is
 ``current > (1 + threshold) * best`` -- so ``serve_p99_ms`` and
 ``serve_solves_per_sec`` (plus their ``serve_async_*`` twins from the
-ISSUE-14 pipelined front) gate serving latency/throughput alongside the
-TFLOP/s headlines.  Nested documents under the
+ISSUE-14 pipelined front, and the windowed worst-per-tenant
+``serve_slo_p99_ms`` from the ISSUE-20 SLO monitor) gate serving
+latency/throughput alongside the TFLOP/s headlines.  Nested documents under the
 ``"obs"`` key (the ``obs_bench/v1`` trail, including ISSUE 8's
 ``redist_wire_bytes`` total) are accepted and surfaced as informational
 lines, never gated -- byte estimates are schedule properties, not
@@ -72,6 +73,7 @@ DEFAULT_METRICS = ("vs_baseline", "lu_vs_baseline",
                    "serve_p99_ms", "serve_solves_per_sec",
                    "serve_async_p99_ms", "serve_async_solves_per_sec",
                    "serve_fleet_p99_ms", "serve_fleet_solves_per_sec",
+                   "serve_slo_p99_ms",
                    "redist_p2p_gbps")
 DEFAULT_THRESHOLD = 0.10
 
@@ -88,6 +90,7 @@ DEFAULT_PER_METRIC = {"lu_n32768_tflops_per_chip": 0.25,
                       "serve_async_solves_per_sec": 0.25,
                       "serve_fleet_p99_ms": 0.25,
                       "serve_fleet_solves_per_sec": 0.25,
+                      "serve_slo_p99_ms": 0.25,
                       "redist_p2p_gbps": 0.40}
 
 #: metrics where SMALLER is better (latency percentiles from
@@ -95,7 +98,8 @@ DEFAULT_PER_METRIC = {"lu_n32768_tflops_per_chip": 0.25,
 #: a regression is ``current > (1 + threshold) * best``.
 LOWER_IS_BETTER = {"serve_p50_ms", "serve_p99_ms",
                    "serve_async_p50_ms", "serve_async_p99_ms",
-                   "serve_fleet_p50_ms", "serve_fleet_p99_ms"}
+                   "serve_fleet_p50_ms", "serve_fleet_p99_ms",
+                   "serve_slo_p99_ms"}
 
 _ROUND_RE = re.compile(r"_r(\d+)\.json$")
 

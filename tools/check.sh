@@ -21,7 +21,11 @@
 #                             #   certified-solve smoke whose first rung
 #                             #   runs int8 wire precision)
 #   tools/check.sh tune       # cost-model self-check + tests/tune only
-#   tools/check.sh obs        # perf.trace smoke + bench_diff gate + tests/obs
+#   tools/check.sh obs        # perf.trace smoke + the ISSUE-20 fleet
+#                             #   telemetry smoke (perf.trace serve
+#                             #   --smoke: lifecycle timelines, SLO
+#                             #   snapshot, flight-record replay) +
+#                             #   bench_diff gate + tests/obs
 #   tools/check.sh lapack     # calu/tsqr gate: lu/qr comm lint + golden diff,
 #                             #   golden-coverage check, lapack lu/qr tests
 #   tools/check.sh resilience # certified-solve smoke (1x1 + 2x2, CPU-safe)
@@ -137,6 +141,12 @@ if [ "$what" = "all" ] || [ "$what" = "obs" ]; then
     echo "== perf.trace smoke (tiny n, 1x1 grid, CPU-safe) =="
     JAX_PLATFORMS=cpu python -m perf.trace run cholesky --n 64 --nb 16 \
         --grid 1x1 --out /tmp/el_trace_smoke.json >/dev/null || rc=1
+    echo "== perf.trace serve smoke (fleet lifecycle + SLO + flight, ISSUE 20) =="
+    # self-checking: complete timelines, flow-linked export with >= 2
+    # grid-worker tracks, per-tenant SLO snapshot, and a bit-identical
+    # flight-record replay of the grid-loss chaos cell
+    JAX_PLATFORMS=cpu python -m perf.trace serve --smoke \
+        --out /tmp/el_serve_trace_smoke.json >/dev/null || rc=1
     echo "== bench-trajectory regression gate =="
     # newest recorded bench vs the best of the earlier rounds (10% default
     # threshold on the roofline-normalized ratios)
